@@ -1,0 +1,32 @@
+"""apex_tpu — a TPU-native mixed-precision & model-parallel training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of NVIDIA Apex
+(reference: mohit-mhjn/apex). Where Apex patches eager PyTorch (monkey-patched
+casts, grad hooks, bucketed NCCL allreduce, multi-tensor CUDA launches), this
+framework expresses the same *semantics* as functional JAX transforms compiled
+by XLA onto TPU:
+
+- ``apex_tpu.amp``          — O0–O3 precision policies + dynamic loss scaling
+                              (reference: apex/amp/)
+- ``apex_tpu.optimizers``   — fused multi-tensor optimizers as single jitted
+                              tree updates (reference: apex/optimizers/, csrc/multi_tensor_*.cu)
+- ``apex_tpu.normalization``— fused LayerNorm/RMSNorm backed by Pallas kernels
+                              (reference: apex/normalization/, csrc/layer_norm_cuda_kernel.cu)
+- ``apex_tpu.parallel``     — data-parallel runtime + SyncBatchNorm over mesh
+                              axes (reference: apex/parallel/)
+- ``apex_tpu.transformer``  — Megatron-style tensor/pipeline/sequence parallel
+                              framework over a jax.sharding.Mesh
+                              (reference: apex/transformer/)
+- ``apex_tpu.ops``          — Pallas TPU kernels + lax reference paths
+                              (reference: csrc/, apex/contrib/csrc/)
+- ``apex_tpu.models``       — reference model zoo (ResNet, GPT, BERT, MLP)
+                              (reference: examples/, apex/transformer/testing/)
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu import amp  # noqa: F401
+from apex_tpu import optimizers  # noqa: F401
+from apex_tpu.utils.log_util import get_logger  # noqa: F401
+
+logger = get_logger()

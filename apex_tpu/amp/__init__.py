@@ -1,0 +1,13 @@
+"""apex_tpu.amp — mixed precision with O0–O3 policies and loss scaling.
+
+Reference: apex/amp/ (SURVEY.md §2.1). See frontend.py for the design mapping.
+"""
+
+from apex_tpu.precision import Policy, get_policy, cast_params, upcast_params  # noqa: F401
+from apex_tpu.amp.scaler import LossScaler, check_overflow  # noqa: F401
+from apex_tpu.amp.frontend import (  # noqa: F401
+    AmpTrainState,
+    MixedPrecisionOptimizer,
+    MPOptState,
+    initialize,
+)

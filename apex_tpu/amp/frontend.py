@@ -1,0 +1,258 @@
+"""amp frontend — initialize, mixed-precision optimizer, train state.
+
+The TPU-native re-design of apex.amp's user surface:
+
+- ``initialize(params, optimizer, opt_level=..., **overrides)`` mirrors
+  ``apex.amp.initialize`` (reference: apex/amp/frontend.py:195-358 +
+  _initialize.py:145-263): casts params per policy, wraps the optimizer with
+  master weights + loss scaling + overflow skip.
+- ``MixedPrecisionOptimizer`` replaces the reference's in-place optimizer
+  surgery (_process_optimizer.py:321-489: ``_amp_stash`` master clones, patched
+  ``step``/``zero_grad``, pre/post-backward hooks). In functional JAX all of
+  that state is an explicit pytree and "patching step" is a ``lax.cond``.
+- ``AmpTrainState`` is the convenience bundle (flax TrainState analog) used by
+  the examples.
+
+What has no analog and why: O1's namespace monkey-patching
+(apex/amp/amp.py:68-177) casts call sites at runtime; under tracing, casts are
+explicit in the model code, so O1 here means "params fp32, compute bf16" via
+policy-aware modules (see apex_tpu.precision.Policy.op_dtype).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from apex_tpu import precision as _precision
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.ops.multi_tensor import tree_scale
+from apex_tpu.optimizers._common import ClassOptimizer
+
+
+class MPOptState(NamedTuple):
+    """Optimizer + amp carried state.
+
+    ``master`` holds fp32 master weights when the policy asks for them
+    (the ``_amp_stash`` fp32_from_fp16 groups of _process_optimizer.py:28-90);
+    otherwise None. ``inner`` is the wrapped transform's state, always built
+    over the fp32 view of params. ``scaler`` is the loss-scale state machine.
+    """
+
+    inner: Any
+    master: Any
+    scaler: LossScaler
+
+
+def _scaler_from_policy(policy: _precision.Policy, **scaler_kwargs) -> LossScaler:
+    return LossScaler.create(loss_scale=policy.loss_scale, **scaler_kwargs)
+
+
+class MixedPrecisionOptimizer:
+    """Wraps an optax transform with amp semantics.
+
+    Per step (cf. the reference's scale_loss exit path, handle.py:107-154, and
+    patched step, _process_optimizer.py:353-364):
+
+    1. unscale grads by 1/loss_scale into fp32, detecting non-finites;
+    2. all-reduce of found_inf is the caller's job when running under a mesh
+       (see apex_tpu.transformer.amp.MeshGradScaler);
+    3. ``lax.cond(found_inf)``: skip (state unchanged) or apply the inner
+       update to the fp32 master params;
+    4. cast masters back to the model dtypes (multi_tensor_scale copy-out,
+       _process_optimizer.py:14-25);
+    5. scaler.update(found_inf).
+    """
+
+    def __init__(
+        self,
+        optimizer: Union[optax.GradientTransformation, ClassOptimizer],
+        policy: _precision.Policy,
+        **scaler_kwargs,
+    ):
+        self.inner = (
+            optimizer.transform if isinstance(optimizer, ClassOptimizer) else optimizer
+        )
+        self.policy = policy
+        self._scaler_kwargs = scaler_kwargs
+
+    def init(self, model_params) -> MPOptState:
+        if self.policy.master_weights:
+            master = _precision.upcast_params(model_params)
+        else:
+            master = None
+        inner = self.inner.init(master if master is not None else model_params)
+        return MPOptState(
+            inner=inner,
+            master=master,
+            scaler=_scaler_from_policy(self.policy, **self._scaler_kwargs),
+        )
+
+    def scale_loss(self, loss: jax.Array, state: MPOptState) -> jax.Array:
+        """``with amp.scale_loss(...)`` enter path (handle.py:113)."""
+        return state.scaler.scale(loss)
+
+    def apply_gradients(
+        self,
+        state: MPOptState,
+        model_params,
+        scaled_grads,
+        *,
+        found_inf_reducer: Optional[Callable[[jax.Array], jax.Array]] = None,
+        **update_kwargs,
+    ):
+        """Returns ``(new_model_params, new_state, metrics)``.
+
+        ``scaled_grads`` are grads of the *scaled* loss w.r.t. model params.
+        ``found_inf_reducer`` lets callers all-reduce the overflow flag across
+        a mesh axis (the model-parallel reduction of
+        apex/transformer/amp/grad_scaler.py:25-36).
+        """
+        grads32, found_inf = state.scaler.unscale(scaled_grads, out_dtype=jnp.float32)
+        if found_inf_reducer is not None:
+            found_inf = found_inf_reducer(found_inf)
+
+        step_params = state.master if state.master is not None else model_params
+
+        def _do_step(operand):
+            params, inner_state = operand
+            updates, new_inner = self.inner.update(
+                grads32, inner_state, params, **update_kwargs
+            )
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_inner
+
+        def _skip_step(operand):
+            return operand
+
+        new_step_params, new_inner = jax.lax.cond(
+            found_inf, _skip_step, _do_step, (step_params, state.inner)
+        )
+
+        if state.master is not None:
+            # master -> model copy-out in the model dtypes.
+            new_model = jax.tree.map(
+                lambda mp, p: mp.astype(p.dtype), new_step_params, model_params
+            )
+            new_master = new_step_params
+        else:
+            new_model = new_step_params
+            new_master = None
+
+        new_scaler = state.scaler.update(found_inf)
+        metrics = {
+            "found_inf": found_inf,
+            "loss_scale": new_scaler.loss_scale,
+        }
+        return new_model, MPOptState(new_inner, new_master, new_scaler), metrics
+
+    # -- checkpointing (apex/amp/frontend.py:361-400) -----------------------
+    def state_dict(self, state: MPOptState):
+        return {"scaler": state.scaler.state_dict()}
+
+    def load_state_dict(self, state: MPOptState, payload) -> MPOptState:
+        return state._replace(scaler=state.scaler.load_state_dict(payload["scaler"]))
+
+
+class AmpTrainState(struct.PyTreeNode):
+    """Bundled train state: params + amp optimizer state + step counter.
+
+    The functional analog of "model, optimizer = amp.initialize(...)" followed
+    by a torch train loop; built by :func:`initialize`.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: MPOptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    mp_optimizer: MixedPrecisionOptimizer = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, params, mp_optimizer):
+        return cls(
+            step=jnp.zeros([], jnp.int32),
+            params=params,
+            opt_state=mp_optimizer.init(params),
+            apply_fn=apply_fn,
+            mp_optimizer=mp_optimizer,
+        )
+
+    @property
+    def scaler(self) -> LossScaler:
+        return self.opt_state.scaler
+
+    def scale_loss(self, loss):
+        return self.mp_optimizer.scale_loss(loss, self.opt_state)
+
+    def apply_gradients(self, scaled_grads, *, found_inf_reducer=None, **kw):
+        new_params, new_opt, metrics = self.mp_optimizer.apply_gradients(
+            self.opt_state,
+            self.params,
+            scaled_grads,
+            found_inf_reducer=found_inf_reducer,
+            **kw,
+        )
+        return (
+            self.replace(step=self.step + 1, params=new_params, opt_state=new_opt),
+            metrics,
+        )
+
+
+def initialize(
+    params,
+    optimizers=None,
+    opt_level: str = "O1",
+    *,
+    apply_fn: Optional[Callable] = None,
+    cast_model_type=None,
+    keep_batchnorm_fp32=None,
+    master_weights=None,
+    loss_scale=None,
+    min_loss_scale: Optional[float] = None,
+    max_loss_scale: float = 2.0 ** 24,
+    half_dtype=jnp.bfloat16,
+    verbosity: int = 1,
+):
+    """TPU-native ``amp.initialize`` (reference: apex/amp/frontend.py:195-358).
+
+    Args mirror the reference's keyword surface where meaningful. Returns
+    ``(cast_params, mp_optimizer)`` — or, when ``apply_fn`` is given, an
+    :class:`AmpTrainState`. ``optimizers`` may be a single optax transform /
+    ClassOptimizer or None (inference only, like the reference's
+    optimizers=None path, _initialize.py:220-222).
+    """
+    policy = _precision.get_policy(
+        opt_level,
+        half_dtype=half_dtype,
+        cast_model_type=cast_model_type,
+        keep_batchnorm_fp32=keep_batchnorm_fp32,
+        master_weights=master_weights,
+        loss_scale=loss_scale,
+    )
+    if verbosity:
+        from apex_tpu.utils.log_util import maybe_print
+
+        maybe_print(
+            f"apex_tpu.amp: opt_level={policy.opt_level} cast_model_type="
+            f"{policy.cast_model_type} master_weights={policy.master_weights} "
+            f"loss_scale={policy.loss_scale}",
+            rank0=True,
+        )
+
+    cast = _precision.cast_params(params, policy)
+    if optimizers is None:
+        return cast, policy
+
+    mp_opt = MixedPrecisionOptimizer(
+        optimizers,
+        policy,
+        min_loss_scale=min_loss_scale,
+        max_loss_scale=max_loss_scale,
+    )
+    if apply_fn is not None:
+        return AmpTrainState.create(apply_fn=apply_fn, params=cast, mp_optimizer=mp_opt)
+    return cast, mp_opt

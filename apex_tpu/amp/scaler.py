@@ -1,0 +1,118 @@
+"""Loss scaling as a functional pytree state machine.
+
+Reference: apex/amp/scaler.py — ``LossScaler`` with static or dynamic scale
+(init 2**16, x2 every 2000 clean steps, /2 on overflow, min/max caps,
+scaler.py:38-71,197-217) and fused unscale-with-overflow-check
+(scaler.py:105-178). In JAX the scaler must be explicit carried state (the
+reference mutates ``self``); skip-on-overflow becomes a ``lax.cond`` in the
+optimizer rather than patching ``optimizer.step`` (apex/amp/handle.py:127-154).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from apex_tpu.ops.multi_tensor import tree_nonfinite, tree_scale
+
+
+@struct.dataclass
+class LossScaler:
+    """Carried loss-scale state. Create with ``LossScaler.create``.
+
+    Fields mirror apex/amp/scaler.py:38-61: ``loss_scale`` (current scale),
+    ``unskipped`` (clean-step counter), and static config ``dynamic``,
+    ``scale_factor``, ``scale_window``, ``min_loss_scale``, ``max_loss_scale``.
+    """
+
+    loss_scale: jax.Array
+    unskipped: jax.Array
+    dynamic: bool = struct.field(pytree_node=False, default=False)
+    scale_factor: float = struct.field(pytree_node=False, default=2.0)
+    scale_window: int = struct.field(pytree_node=False, default=2000)
+    # None = no floor, matching the reference default (apex/amp/scaler.py:43)
+    min_loss_scale: Optional[float] = struct.field(pytree_node=False, default=None)
+    max_loss_scale: float = struct.field(pytree_node=False, default=2.0 ** 24)
+
+    @classmethod
+    def create(
+        cls,
+        loss_scale: Union[str, float] = "dynamic",
+        init_scale: float = 2.0 ** 16,
+        scale_factor: float = 2.0,
+        scale_window: int = 2000,
+        min_loss_scale: Optional[float] = None,
+        max_loss_scale: float = 2.0 ** 24,
+    ) -> "LossScaler":
+        dynamic = loss_scale == "dynamic"
+        scale = init_scale if dynamic else float(loss_scale)
+        return cls(
+            loss_scale=jnp.asarray(scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32),
+            dynamic=dynamic,
+            scale_factor=scale_factor,
+            scale_window=scale_window,
+            min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale,
+        )
+
+    # -- forward side -------------------------------------------------------
+    def scale(self, loss: jax.Array) -> jax.Array:
+        """``loss.float() * loss_scale`` (apex/amp/handle.py:113)."""
+        return loss.astype(jnp.float32) * self.loss_scale
+
+    # -- backward side ------------------------------------------------------
+    def unscale(self, grads, out_dtype=None) -> Tuple[Any, jax.Array]:
+        """Unscale a grad tree, returning ``(grads, found_inf)``.
+
+        Equivalent of ``LossScaler.unscale`` driving
+        ``multi_tensor_scale(1/scale)`` with the overflow buffer
+        (apex/amp/scaler.py:105-117).
+        """
+        inv = 1.0 / self.loss_scale
+        return tree_scale(grads, inv, out_dtype=out_dtype)
+
+    def update(self, found_inf: jax.Array) -> "LossScaler":
+        """Post-step scale adjustment (apex/amp/scaler.py:197-217).
+
+        On overflow: scale /= factor (floored at min), counter reset. After
+        ``scale_window`` clean steps: scale *= factor (capped at max).
+        """
+        if not self.dynamic:
+            return self
+        found_inf = jnp.asarray(found_inf)
+        new_unskipped = jnp.where(found_inf, 0, self.unskipped + 1)
+        grown = new_unskipped >= self.scale_window
+        floor = self.min_loss_scale if self.min_loss_scale is not None else 0.0
+        scale = jnp.where(
+            found_inf,
+            jnp.maximum(self.loss_scale / self.scale_factor, floor),
+            jnp.where(
+                grown,
+                jnp.minimum(self.loss_scale * self.scale_factor, self.max_loss_scale),
+                self.loss_scale,
+            ),
+        )
+        new_unskipped = jnp.where(grown, 0, new_unskipped)
+        return self.replace(loss_scale=scale, unskipped=new_unskipped)
+
+    # -- checkpointing (apex/amp/frontend.py:361-400) -----------------------
+    def state_dict(self):
+        return {
+            "loss_scale": self.loss_scale,
+            "unskipped": self.unskipped,
+        }
+
+    def load_state_dict(self, state) -> "LossScaler":
+        return self.replace(
+            loss_scale=jnp.asarray(state["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(state["unskipped"], jnp.int32),
+        )
+
+
+def check_overflow(grads) -> jax.Array:
+    """Standalone overflow probe (apex/amp/scaler.py:6-31 python fallback)."""
+    return tree_nonfinite(grads)

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels and XLA-fused op compositions.
+
+TPU-native replacements for the reference's ``csrc/`` CUDA extensions
+(SURVEY.md §2.2). Each op ships a lax/jnp reference path (used under
+``interpret`` / CPU test meshes) and, where it pays, a Pallas TPU kernel.
+"""
+
+from apex_tpu.ops.multi_tensor import (  # noqa: F401
+    tree_scale,
+    tree_axpby,
+    tree_l2norm,
+    tree_l2norm_per_tensor,
+    tree_nonfinite,
+)

@@ -1,0 +1,118 @@
+"""Tree-level fused tensor ops — the multi_tensor_apply equivalent.
+
+The reference batches elementwise updates over lists of tensors into single
+CUDA launches via ``apex.multi_tensor_apply`` + ``amp_C`` kernels
+(reference: apex/multi_tensor_apply/multi_tensor_apply.py:3-30,
+csrc/multi_tensor_apply.cuh:16-133, csrc/multi_tensor_scale_kernel.cu,
+csrc/multi_tensor_axpby_kernel.cu, csrc/multi_tensor_l2norm_kernel.cu).
+
+On TPU the launch-batching problem does not exist: a ``jax.tree.map`` inside a
+jitted function is traced into one XLA program and fused by the compiler, so
+these helpers express only the *semantics* — scaling with non-finite
+detection, axpby grad accumulation, and global/per-tensor L2 norms — as pure
+functions over pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _float_leaves(tree):
+    # matches jax arrays, numpy arrays, and python/np floats alike
+    return [
+        l
+        for l in jax.tree.leaves(tree)
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+    ]
+
+
+def tree_nonfinite(tree) -> jax.Array:
+    """Return a scalar bool: any non-finite value anywhere in the tree.
+
+    The ``noop_flag`` / ``found_inf`` signal of the reference kernels
+    (csrc/multi_tensor_scale_kernel.cu overflow path; apex/amp/scaler.py:6-31).
+    """
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(l.astype(jnp.float32)))) for l in leaves]
+    return jnp.stack(flags).any()
+
+
+def tree_scale(tree, scale, out_dtype=None) -> Tuple[Any, jax.Array]:
+    """``out = in * scale`` over a pytree, plus overflow flag.
+
+    Equivalent of ``amp_C.multi_tensor_scale`` (csrc/multi_tensor_scale_kernel.cu):
+    the amp unscale and master<->model copy primitive. Returns
+    ``(scaled_tree, found_inf)`` where found_inf reflects non-finites in the
+    *input* (so an overflow in grads is detected even if scaling maps it to 0).
+    """
+    found_inf = tree_nonfinite(tree)
+
+    def _scale(l):
+        if not jnp.issubdtype(l.dtype, jnp.inexact):
+            return l
+        out = l.astype(jnp.float32) * scale
+        return out.astype(out_dtype or l.dtype)
+
+    return jax.tree.map(_scale, tree), found_inf
+
+
+def tree_axpby(a, x_tree, b, y_tree, out_dtype=None) -> Tuple[Any, jax.Array]:
+    """``out = a*x + b*y`` elementwise over two pytrees + overflow flag.
+
+    Equivalent of ``amp_C.multi_tensor_axpby``
+    (csrc/multi_tensor_axpby_kernel.cu), used by the reference to merge
+    stashed gradient accumulators (apex/amp/_process_optimizer.py:161-202).
+    """
+    found_inf = jnp.logical_or(tree_nonfinite(x_tree), tree_nonfinite(y_tree))
+
+    def _axpby(x, y):
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            return x
+        out = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return out.astype(out_dtype or x.dtype)
+
+    return jax.tree.map(_axpby, x_tree, y_tree), found_inf
+
+
+def tree_l2norm(tree) -> jax.Array:
+    """Global L2 norm across every leaf (csrc/multi_tensor_l2norm_kernel.cu).
+
+    Used for LAMB's global grad norm (apex/optimizers/fused_lamb.py:108-136)
+    and gradient clipping.
+    """
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(sq)
+
+
+def tree_l2norm_per_tensor(tree):
+    """Per-leaf L2 norms, same treedef (the ``per_tensor`` kernel output).
+
+    Used by NovoGrad's per-tensor second moments
+    (apex/optimizers/fused_novograd.py) and LAMB trust ratios.
+    """
+    return jax.tree.map(
+        lambda l: jnp.sqrt(jnp.sum(jnp.square(l.astype(jnp.float32))))
+        if jnp.issubdtype(l.dtype, jnp.inexact)
+        else l,
+        tree,
+    )
+
+
+def tree_clip_by_global_norm(tree, max_norm: float):
+    """Clip a grad tree to a global-norm budget (FP16_Optimizer.clip_master_grads,
+    apex/fp16_utils/fp16_optimizer.py:386-407)."""
+    gnorm = tree_l2norm(tree)
+    factor = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
+    return jax.tree.map(
+        lambda l: (l * factor).astype(l.dtype) if jnp.issubdtype(l.dtype, jnp.inexact) else l,
+        tree,
+    ), gnorm

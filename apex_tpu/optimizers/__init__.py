@@ -1,0 +1,21 @@
+"""Fused optimizers — TPU-native equivalents of ``apex.optimizers``.
+
+The reference's optimizers batch per-parameter updates into single
+``multi_tensor_*`` CUDA launches (apex/optimizers/fused_adam.py:117-170 etc.,
+csrc/multi_tensor_adam.cu, csrc/multi_tensor_lamb.cu, ...). Under XLA, an
+optimizer whose update is a single traced ``tree.map`` compiles to the same
+thing — one fused elementwise pass over all parameters — so these are
+implemented as optax-compatible ``GradientTransformation`` factories, with
+thin class aliases matching the reference names.
+
+``scale`` / overflow interop (the deprecated contrib optimizers' explicit
+``scale`` arg, apex/contrib/optimizers/fused_adam.py:90+) lives one level up
+in ``apex_tpu.amp.MixedPrecisionOptimizer``.
+"""
+
+from apex_tpu.optimizers.fused_adam import fused_adam, FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import fused_lamb, FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import fused_sgd, FusedSGD  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import fused_novograd, FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import fused_adagrad, FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.larc import larc, LARC  # noqa: F401

@@ -1,0 +1,56 @@
+"""Shared plumbing for fused optimizers."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def tree_zeros_like(params, dtype=jnp.float32):
+    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=dtype), params)
+
+
+def multi_tree_map(fn, *trees, n_out: int):
+    """Map ``fn`` over N parallel trees where fn returns an ``n_out``-tuple;
+    returns ``n_out`` trees. The structural analog of a multi_tensor kernel
+    emitting several output lists (csrc/multi_tensor_apply.cuh works on
+    tensor-list tuples). ``n_out`` must be given explicitly so an empty param
+    tree (e.g. an optax.masked group) yields empty trees instead of crashing."""
+    treedef = jax.tree.structure(trees[0])
+    flat_sets = [treedef.flatten_up_to(t) for t in trees]
+    results = [fn(*leaves) for leaves in zip(*flat_sets)]
+    return tuple(treedef.unflatten([r[i] for r in results]) for i in range(n_out))
+
+
+def cast_like(updates, params):
+    """Emit updates in each param's dtype (state math stays fp32)."""
+    return jax.tree.map(lambda u, p: u.astype(p.dtype), updates, params)
+
+
+class ClassOptimizer:
+    """Small adapter giving optax transforms the reference's class spelling.
+
+    ``FusedAdam(lr=...)`` in the reference is a torch Optimizer; here the
+    class wraps a ``GradientTransformation`` so both styles work:
+
+        tx = apex_tpu.optimizers.FusedAdam(lr=1e-3)
+        state = tx.init(params)
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    """
+
+    def __init__(self, transform: optax.GradientTransformation):
+        self._tx = transform
+
+    def init(self, params):
+        return self._tx.init(params)
+
+    def update(self, grads, state, params=None, **extra):
+        return self._tx.update(grads, state, params, **extra)
+
+    @property
+    def transform(self) -> optax.GradientTransformation:
+        return self._tx

@@ -1,0 +1,66 @@
+"""FusedAdagrad — Adagrad as one fused tree update.
+
+Reference: apex/optimizers/fused_adagrad.py + csrc/multi_tensor_adagrad.cu
+(``h += g^2; p -= lr * g / (sqrt(h) + eps)`` with optional decoupled
+``adagrad_w_mode`` weight decay).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import (
+    ClassOptimizer,
+    cast_like,
+    multi_tree_map,
+    tree_zeros_like,
+)
+
+
+class FusedAdagradState(NamedTuple):
+    step: jax.Array
+    sum_sq: optax.Params
+
+
+def fused_adagrad(
+    lr: float = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        return FusedAdagradState(
+            step=jnp.zeros([], jnp.int32), sum_sq=tree_zeros_like(params)
+        )
+
+    def update_fn(grads, state, params=None, *, lr_t=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+        step_lr = jnp.asarray(lr_t if lr_t is not None else lr, jnp.float32)
+
+        def _upd(g, p, h):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay != 0.0 and not adagrad_w_mode:
+                g32 = g32 + weight_decay * p32
+            h_new = h + jnp.square(g32)
+            upd = -step_lr * g32 / (jnp.sqrt(h_new) + eps)
+            if weight_decay != 0.0 and adagrad_w_mode:
+                upd = upd - step_lr * weight_decay * p32
+            return upd, h_new
+
+        updates, new_h = multi_tree_map(_upd, grads, params, state.sum_sq, n_out=2)
+        return cast_like(updates, params), FusedAdagradState(state.step + 1, new_h)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedAdagrad(ClassOptimizer):
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0, adagrad_w_mode=False, **_ignored):
+        super().__init__(
+            fused_adagrad(lr=lr, eps=eps, weight_decay=weight_decay, adagrad_w_mode=adagrad_w_mode)
+        )

@@ -1,0 +1,107 @@
+"""FusedAdam — Adam/AdamW as one fused tree update.
+
+Reference: apex/optimizers/fused_adam.py:4-173 (python driver building per-dtype
+g/p/m/v lists, :117-170) + csrc/multi_tensor_adam.cu (elementwise update with
+``adam_w_mode`` flag and bias correction). Under jit the whole tree update is a
+single XLA computation — the fusion the CUDA kernel exists to provide.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import (
+    ClassOptimizer,
+    cast_like,
+    multi_tree_map,
+    tree_zeros_like,
+)
+
+
+class FusedAdamState(NamedTuple):
+    step: jax.Array  # int32 step count
+    exp_avg: optax.Params  # first moment (fp32)
+    exp_avg_sq: optax.Params  # second moment (fp32)
+
+
+def fused_adam(
+    lr: float = 1e-3,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    adam_w_mode: bool = True,
+    bias_correction: bool = True,
+    amsgrad: bool = False,
+) -> optax.GradientTransformation:
+    """Adam with apex's knobs (fused_adam.py:41-77). ``adam_w_mode=True`` is
+    decoupled weight decay (AdamW); False applies L2 into the gradient."""
+    if amsgrad:
+        raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+    beta1, beta2 = betas
+
+    def init_fn(params):
+        return FusedAdamState(
+            step=jnp.zeros([], jnp.int32),
+            exp_avg=tree_zeros_like(params),
+            exp_avg_sq=tree_zeros_like(params),
+        )
+
+    def update_fn(grads, state, params=None, *, lr_t=None):
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        step = state.step + 1
+        step_lr = jnp.asarray(lr_t if lr_t is not None else lr, jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        def _upd(g, p, m, v):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not adam_w_mode and weight_decay != 0.0:
+                g32 = g32 + weight_decay * p32
+            m_new = beta1 * m + (1.0 - beta1) * g32
+            v_new = beta2 * v + (1.0 - beta2) * jnp.square(g32)
+            denom = jnp.sqrt(v_new / bc2) + eps
+            upd = -step_lr * (m_new / bc1) / denom
+            if adam_w_mode and weight_decay != 0.0:
+                upd = upd - step_lr * weight_decay * p32
+            return upd, m_new, v_new
+
+        updates, new_m, new_v = multi_tree_map(
+            _upd, grads, params, state.exp_avg, state.exp_avg_sq, n_out=3
+        )
+        return cast_like(updates, params), FusedAdamState(step, new_m, new_v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedAdam(ClassOptimizer):
+    def __init__(
+        self,
+        lr=1e-3,
+        bias_correction=True,
+        betas=(0.9, 0.999),
+        eps=1e-8,
+        adam_w_mode=True,
+        weight_decay=0.0,
+        amsgrad=False,
+        **_ignored,
+    ):
+        super().__init__(
+            fused_adam(
+                lr=lr,
+                betas=betas,
+                eps=eps,
+                weight_decay=weight_decay,
+                adam_w_mode=adam_w_mode,
+                bias_correction=bias_correction,
+                amsgrad=amsgrad,
+            )
+        )

@@ -1,0 +1,127 @@
+"""FusedLAMB — layer-wise adaptive large-batch optimizer.
+
+Reference: apex/optimizers/fused_lamb.py — two-phase step: (1) global grad
+norm via ``multi_tensor_l2norm`` (:108-136), (2) ``multi_tensor_lamb``
+(csrc/multi_tensor_lamb.cu): Adam-style moments, per-tensor param/update
+norms, trust ratio ``||p|| / ||update||``, scaled apply. Knobs preserved:
+``bias_correction``, ``grad_averaging``, ``adam_w_mode``, ``max_grad_norm``
+(global clip), ``use_nvlamb`` (apply trust ratio even where weight_decay==0).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.ops.multi_tensor import tree_l2norm
+from apex_tpu.optimizers._common import (
+    ClassOptimizer,
+    cast_like,
+    multi_tree_map,
+    tree_zeros_like,
+)
+
+
+class FusedLAMBState(NamedTuple):
+    step: jax.Array
+    exp_avg: optax.Params
+    exp_avg_sq: optax.Params
+
+
+def fused_lamb(
+    lr: float = 1e-3,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    bias_correction: bool = True,
+    grad_averaging: bool = True,
+    adam_w_mode: bool = True,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+) -> optax.GradientTransformation:
+    beta1, beta2 = betas
+    if not adam_w_mode:
+        raise RuntimeError("FusedLAMB only supports adam_w_mode (decoupled wd), as the reference kernel does.")
+
+    def init_fn(params):
+        return FusedLAMBState(
+            step=jnp.zeros([], jnp.int32),
+            exp_avg=tree_zeros_like(params),
+            exp_avg_sq=tree_zeros_like(params),
+        )
+
+    def update_fn(grads, state, params=None, *, lr_t=None):
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        step = state.step + 1
+        step_lr = jnp.asarray(lr_t if lr_t is not None else lr, jnp.float32)
+        beta1_grad = (1.0 - beta1) if grad_averaging else 1.0
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        # Phase 1: global grad norm + clip factor (fused_lamb.py:108-136).
+        global_norm = tree_l2norm(grads)
+        if max_grad_norm and max_grad_norm > 0:
+            clip = jnp.maximum(1.0, global_norm / max_grad_norm)
+        else:
+            clip = jnp.asarray(1.0, jnp.float32)
+
+        def _upd(g, p, m, v):
+            g32 = g.astype(jnp.float32) / clip
+            p32 = p.astype(jnp.float32)
+            m_new = beta1 * m + beta1_grad * g32
+            v_new = beta2 * v + (1.0 - beta2) * jnp.square(g32)
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay != 0.0:
+                upd = upd + weight_decay * p32
+            # Per-tensor trust ratio (multi_tensor_lamb.cu stage 2).
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.asarray(1.0, jnp.float32)
+            )
+            if weight_decay == 0.0 and not use_nvlamb:
+                ratio = jnp.asarray(1.0, jnp.float32)
+            return (-step_lr * ratio * upd, m_new, v_new)
+
+        updates, new_m, new_v = multi_tree_map(
+            _upd, grads, params, state.exp_avg, state.exp_avg_sq, n_out=3
+        )
+        return cast_like(updates, params), FusedLAMBState(step, new_m, new_v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedLAMB(ClassOptimizer):
+    def __init__(
+        self,
+        lr=1e-3,
+        bias_correction=True,
+        betas=(0.9, 0.999),
+        eps=1e-6,
+        weight_decay=0.01,
+        grad_averaging=True,
+        adam_w_mode=True,
+        max_grad_norm=1.0,
+        use_nvlamb=False,
+        **_ignored,
+    ):
+        super().__init__(
+            fused_lamb(
+                lr=lr,
+                betas=betas,
+                eps=eps,
+                weight_decay=weight_decay,
+                bias_correction=bias_correction,
+                grad_averaging=grad_averaging,
+                adam_w_mode=adam_w_mode,
+                max_grad_norm=max_grad_norm,
+                use_nvlamb=use_nvlamb,
+            )
+        )

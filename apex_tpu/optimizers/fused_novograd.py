@@ -1,0 +1,113 @@
+"""FusedNovoGrad — Adam variant with per-tensor (layer-wise) second moments.
+
+Reference: apex/optimizers/fused_novograd.py + csrc/multi_tensor_novograd.cu:
+``v`` is a scalar per tensor (norm of the grad), first step initialises
+``v = ||g||^2`` (``init_zero=False`` default), ``norm_type=2``, decoupled or
+L2 weight decay via ``reg_inside_moment``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import (
+    ClassOptimizer,
+    cast_like,
+    multi_tree_map,
+    tree_zeros_like,
+)
+
+
+class FusedNovoGradState(NamedTuple):
+    step: jax.Array
+    exp_avg: optax.Params
+    exp_avg_sq: optax.Params  # scalar per tensor
+
+
+def fused_novograd(
+    lr: float = 1e-3,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = True,
+    init_zero: bool = False,
+    reg_inside_moment: bool = False,
+    bias_correction: bool = True,
+) -> optax.GradientTransformation:
+    beta1, beta2 = betas
+
+    def init_fn(params):
+        return FusedNovoGradState(
+            step=jnp.zeros([], jnp.int32),
+            exp_avg=tree_zeros_like(params),
+            exp_avg_sq=jax.tree.map(lambda p: jnp.zeros([], jnp.float32), params),
+        )
+
+    def update_fn(grads, state, params=None, *, lr_t=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        step = state.step + 1
+        step_lr = jnp.asarray(lr_t if lr_t is not None else lr, jnp.float32)
+        beta1_grad = (1.0 - beta1) if grad_averaging else 1.0
+        first = state.step == 0
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        def _upd(g, p, m, v):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            gnorm_sq = jnp.sum(jnp.square(g32))
+            if init_zero:
+                v_new = beta2 * v + (1.0 - beta2) * gnorm_sq
+            else:
+                v_new = jnp.where(first, gnorm_sq, beta2 * v + (1.0 - beta2) * gnorm_sq)
+            denom = jnp.sqrt(v_new / bc2) + eps
+            gn = g32 / denom
+            if weight_decay != 0.0 and reg_inside_moment:
+                gn = gn + weight_decay * p32
+            m_new = beta1 * m + beta1_grad * gn
+            upd = m_new / bc1
+            if weight_decay != 0.0 and not reg_inside_moment:
+                upd = upd + weight_decay * p32
+            return (-step_lr * upd, m_new, v_new)
+
+        updates, new_m, new_v = multi_tree_map(
+            _upd, grads, params, state.exp_avg, state.exp_avg_sq, n_out=3
+        )
+        return cast_like(updates, params), FusedNovoGradState(step, new_m, new_v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedNovoGrad(ClassOptimizer):
+    def __init__(
+        self,
+        lr=1e-3,
+        bias_correction=True,
+        betas=(0.9, 0.999),
+        eps=1e-8,
+        weight_decay=0.0,
+        grad_averaging=True,
+        init_zero=False,
+        reg_inside_moment=False,
+        **_ignored,
+    ):
+        super().__init__(
+            fused_novograd(
+                lr=lr,
+                betas=betas,
+                eps=eps,
+                weight_decay=weight_decay,
+                grad_averaging=grad_averaging,
+                init_zero=init_zero,
+                reg_inside_moment=reg_inside_moment,
+                bias_correction=bias_correction,
+            )
+        )

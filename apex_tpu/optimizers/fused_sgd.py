@@ -1,0 +1,100 @@
+"""FusedSGD — SGD + momentum/Nesterov as one fused tree update.
+
+Reference: apex/optimizers/fused_sgd.py + csrc/multi_tensor_sgd_kernel.cu
+(momentum/nesterov/dampening, ``wd_after_momentum`` flag, first-run momentum
+init). The reference's amp interop (``materialize_master_grads``,
+``most_recent_scale``, fused_sgd.py:79-96,138-224) deferred grad unscaling
+into the kernel; here unscaling is handled by the amp layer and fuses in XLA
+anyway.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import (
+    ClassOptimizer,
+    cast_like,
+    multi_tree_map,
+    tree_zeros_like,
+)
+
+
+class FusedSGDState(NamedTuple):
+    step: jax.Array
+    momentum_buf: optax.Params
+
+
+def fused_sgd(
+    lr: float = 1e-3,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    wd_after_momentum: bool = False,
+) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    def init_fn(params):
+        return FusedSGDState(
+            step=jnp.zeros([], jnp.int32),
+            momentum_buf=tree_zeros_like(params),
+        )
+
+    def update_fn(grads, state, params=None, *, lr_t=None):
+        if params is None:
+            raise ValueError("fused_sgd requires params")
+        step = state.step + 1
+        step_lr = jnp.asarray(lr_t if lr_t is not None else lr, jnp.float32)
+        first_run = state.step == 0
+
+        def _upd(g, p, buf):
+            d32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay != 0.0 and not wd_after_momentum:
+                d32 = d32 + weight_decay * p32
+            if momentum != 0.0:
+                # first_run: momentum buffer initialises to the grad itself
+                # (multi_tensor_sgd_kernel.cu first_run flag).
+                buf_new = jnp.where(
+                    first_run, d32, momentum * buf + (1.0 - dampening) * d32
+                )
+                d32 = d32 + momentum * buf_new if nesterov else buf_new
+            else:
+                buf_new = buf
+            if weight_decay != 0.0 and wd_after_momentum:
+                d32 = d32 + weight_decay * p32
+            return -step_lr * d32, buf_new
+
+        updates, new_buf = multi_tree_map(_upd, grads, params, state.momentum_buf, n_out=2)
+        return cast_like(updates, params), FusedSGDState(step, new_buf)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedSGD(ClassOptimizer):
+    def __init__(
+        self,
+        lr=1e-3,
+        momentum=0.0,
+        dampening=0.0,
+        weight_decay=0.0,
+        nesterov=False,
+        wd_after_momentum=False,
+        **_ignored,
+    ):
+        super().__init__(
+            fused_sgd(
+                lr=lr,
+                momentum=momentum,
+                dampening=dampening,
+                weight_decay=weight_decay,
+                nesterov=nesterov,
+                wd_after_momentum=wd_after_momentum,
+            )
+        )

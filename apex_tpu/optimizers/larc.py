@@ -1,0 +1,80 @@
+"""LARC — layer-wise adaptive rate control as a gradient-transform wrapper.
+
+Reference: apex/parallel/LARC.py:5-107 — wraps any optimizer and rescales each
+param's gradient by the adaptive LR
+``trust_coefficient * ||p|| / (||g|| + weight_decay * ||p|| + eps)`` before
+the inner step, either clipped against the base LR (``clip=True``) or used as
+a multiplicative scale. Implemented here as an optax chain-style wrapper.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._common import ClassOptimizer
+
+
+def larc(
+    inner: optax.GradientTransformation,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    base_lr: float = 1.0,
+) -> optax.GradientTransformation:
+    """Wrap ``inner`` with LARC grad rescaling (LARC.py:78-104).
+
+    ``base_lr`` is the LR the inner transform will apply, needed for the
+    ``clip`` mode ratio ``min(adaptive_lr / lr, 1)``.
+    """
+
+    def init_fn(params):
+        return inner.init(params)
+
+    def update_fn(grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError("larc requires params")
+
+        def _rescale(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            pnorm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive_lr = trust_coefficient * pnorm / (gnorm + weight_decay * pnorm + eps)
+            if clip:
+                adaptive_lr = jnp.minimum(adaptive_lr / base_lr, 1.0)
+            # the reference only touches the grad inside the nonzero-norms
+            # branch (LARC.py:92-102): zero-grad params stay untouched.
+            active = (pnorm > 0) & (gnorm > 0)
+            scaled = (g32 + weight_decay * p32) * adaptive_lr
+            return jnp.where(active, scaled, g32).astype(g.dtype)
+
+        grads = jax.tree.map(_rescale, grads, params)
+        return inner.update(grads, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class LARC(ClassOptimizer):
+    def __init__(
+        self,
+        optimizer,
+        trust_coefficient=0.02,
+        clip=True,
+        eps=1e-8,
+        weight_decay=0.0,
+        base_lr=1.0,
+    ):
+        inner = optimizer.transform if isinstance(optimizer, ClassOptimizer) else optimizer
+        super().__init__(
+            larc(
+                inner,
+                trust_coefficient=trust_coefficient,
+                clip=clip,
+                eps=eps,
+                weight_decay=weight_decay,
+                base_lr=base_lr,
+            )
+        )

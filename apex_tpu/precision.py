@@ -1,0 +1,248 @@
+"""Precision policies — the TPU-native equivalent of apex.amp opt levels.
+
+The reference encodes mixed precision as an ``apex.amp.Properties`` object with
+four preset "opt levels" O0–O3 plus keyword overrides
+(reference: apex/amp/frontend.py:7-191). On CUDA the O1 level is implemented by
+monkey-patching torch namespaces with cast wrappers; that mechanism has no JAX
+analog (and needs none: tracing makes casts explicit), so here a policy is a
+frozen dataclass consumed by
+
+- ``apex_tpu.amp.initialize`` / ``MixedPrecisionOptimizer`` (master weights,
+  loss scaling, param casting), and
+- policy-aware modules (``apex_tpu.nn_util.Dense`` etc.) which consult
+  ``compute_dtype`` / ``fp32_ops`` instead of relying on patched call sites.
+
+Semantics preserved from the reference presets (apex/amp/frontend.py:100-191):
+
+====== ==================== ================= ============== ===========
+level  cast_model_type      compute_dtype     master_weights loss_scale
+====== ==================== ================= ============== ===========
+O0     None (fp32)          fp32              False          1.0
+O1     None (fp32 params)   bf16 (whitelist)  False          "dynamic"
+O2     bf16 (norms fp32)    bf16              True           "dynamic"
+O3     bf16                 bf16              False          1.0
+====== ==================== ================= ============== ===========
+
+On TPU the natural half dtype is bfloat16 (no loss scaling strictly required,
+but retained for parity and for fp16 experiments — pass
+``half_dtype=jnp.float16``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, FrozenSet, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+# Op families that stay fp32 under an O1-style policy. Mirrors the FP32
+# blacklist of the reference (apex/amp/lists/torch_overrides.py:29-61,
+# functional_overrides.py:29-68): softmax-like, exponential/log, norms, losses.
+_DEFAULT_FP32_OPS: FrozenSet[str] = frozenset(
+    {
+        "softmax",
+        "log_softmax",
+        "layer_norm",
+        "rms_norm",
+        "batch_norm",
+        "group_norm",
+        "cross_entropy",
+        "mse_loss",
+        "l1_loss",
+        "exp",
+        "log",
+        "pow",
+        "sum",
+        "mean",
+        "norm",
+        "cumsum",
+        "erf",
+        "softplus",
+        "sigmoid_loss",
+    }
+)
+
+# Op families computed in the half dtype under O1 — the FP16 whitelist
+# (apex/amp/lists/torch_overrides.py:7-27): matmuls and convolutions, i.e.
+# everything that lands on the MXU.
+_DEFAULT_HALF_OPS: FrozenSet[str] = frozenset(
+    {"matmul", "conv", "dense", "attention", "einsum", "mlp"}
+)
+
+
+def _canon(dt: Optional[Any]) -> Optional[jnp.dtype]:
+    if dt is None:
+        return None
+    return jnp.dtype(dt)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A mixed-precision policy (apex ``Properties`` equivalent).
+
+    Attributes:
+      opt_level: "O0" | "O1" | "O2" | "O3" (informational once constructed).
+      cast_model_type: dtype model params are stored in, or None for fp32.
+      compute_dtype: dtype MXU-bound ops compute in.
+      keep_batchnorm_fp32: keep norm/batchnorm params + stats fp32 even when
+        params are cast (reference: frontend.py:150-162 O2 default True).
+      master_weights: keep an fp32 master copy of params inside the optimizer
+        (reference: _process_optimizer.py:28-90).
+      loss_scale: "dynamic" or a static float (reference: frontend.py:163-168).
+      fp32_ops: op-family names forced to fp32 (O1 blacklist equivalent).
+      half_ops: op-family names allowed in compute_dtype (O1 whitelist).
+    """
+
+    opt_level: str = "O0"
+    cast_model_type: Optional[jnp.dtype] = None
+    compute_dtype: jnp.dtype = dataclasses.field(default_factory=lambda: jnp.dtype(jnp.float32))
+    keep_batchnorm_fp32: bool = True
+    master_weights: bool = False
+    loss_scale: Union[str, float] = 1.0
+    fp32_ops: FrozenSet[str] = _DEFAULT_FP32_OPS
+    half_ops: FrozenSet[str] = _DEFAULT_HALF_OPS
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == "dynamic"
+
+    @property
+    def param_dtype(self) -> jnp.dtype:
+        return self.cast_model_type or jnp.dtype(jnp.float32)
+
+    def op_dtype(self, op_family: str) -> jnp.dtype:
+        """Compute dtype for an op family under this policy (O1 semantics):
+        blacklisted families are fp32, everything else (the whitelist and
+        promote-list) follows ``compute_dtype``."""
+        if op_family in self.fp32_ops:
+            return jnp.dtype(jnp.float32)
+        return self.compute_dtype
+
+    def cast_to_compute(self, x, op_family: str = "matmul"):
+        """Cast an array (or pytree) to this policy's compute dtype for an op."""
+        dt = self.op_dtype(op_family)
+        return jax.tree.map(
+            lambda a: a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating) else a, x
+        )
+
+
+def _make_policy(
+    opt_level: str,
+    half_dtype=jnp.bfloat16,
+    **overrides,
+) -> Policy:
+    half = jnp.dtype(half_dtype)
+    presets = {
+        "O0": dict(
+            cast_model_type=None,
+            compute_dtype=jnp.dtype(jnp.float32),
+            keep_batchnorm_fp32=True,
+            master_weights=False,
+            loss_scale=1.0,
+        ),
+        "O1": dict(
+            cast_model_type=None,
+            compute_dtype=half,
+            keep_batchnorm_fp32=True,
+            master_weights=False,
+            loss_scale="dynamic",
+        ),
+        "O2": dict(
+            cast_model_type=half,
+            compute_dtype=half,
+            keep_batchnorm_fp32=True,
+            master_weights=True,
+            loss_scale="dynamic",
+        ),
+        "O3": dict(
+            cast_model_type=half,
+            compute_dtype=half,
+            keep_batchnorm_fp32=False,
+            master_weights=False,
+            loss_scale=1.0,
+        ),
+    }
+    if opt_level not in presets:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r}; options are 'O0', 'O1', 'O2', 'O3'."
+        )
+    cfg = presets[opt_level]
+    for k, v in overrides.items():
+        if v is None:
+            continue
+        if k not in cfg and k not in {"fp32_ops", "half_ops"}:
+            raise ValueError(f"Unknown policy override {k!r}")
+        cfg[k] = v
+    if "cast_model_type" in cfg:
+        cfg["cast_model_type"] = _canon(cfg["cast_model_type"])
+    if "compute_dtype" in cfg:
+        cfg["compute_dtype"] = _canon(cfg["compute_dtype"])
+    return Policy(opt_level=opt_level, **cfg)
+
+
+def get_policy(opt_level: Union[str, Policy] = "O1", **overrides) -> Policy:
+    """Build a Policy from an opt level + overrides (apex frontend.py:195-358)."""
+    if isinstance(opt_level, Policy):
+        live = {k: v for k, v in overrides.items() if v is not None and k != "half_dtype"}
+        if live:
+            raise ValueError(
+                f"Overrides {sorted(live)} cannot be combined with a pre-built "
+                "Policy; pass an opt-level string, or dataclasses.replace the Policy."
+            )
+        return opt_level
+    return _make_policy(opt_level, **overrides)
+
+
+# ---------------------------------------------------------------------------
+# Param-tree casting helpers (replace convert_network, fp16util.py:35-99)
+# ---------------------------------------------------------------------------
+
+# Module-path substrings that mark normalization layers (kept fp32 under
+# keep_batchnorm_fp32, like apex's _BatchNorm re-float, fp16util.py:42-49).
+_NORM_KEY_MARKERS = ("norm", "bn_", "batchnorm", "layernorm")
+
+
+def _path_is_norm(path) -> bool:
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key).lower())
+        elif hasattr(p, "name"):
+            names.append(str(p.name).lower())
+    return any(m in n for n in names for m in _NORM_KEY_MARKERS)
+
+
+def cast_params(params, policy: Policy):
+    """Cast a param pytree per policy (reference: _initialize.py:176-182).
+
+    Floating-point leaves are cast to ``policy.param_dtype``; when
+    ``keep_batchnorm_fp32`` is set, leaves living under a module whose path
+    contains a norm marker stay fp32 (the analog of apex converting
+    ``torch.nn.modules.batchnorm._BatchNorm`` back to float,
+    fp16util.py:42-49).
+    """
+    if policy.cast_model_type is None:
+        return params
+
+    def _cast(path, leaf):
+        if not _is_float_array(leaf):
+            return leaf
+        if policy.keep_batchnorm_fp32 and _path_is_norm(path):
+            return jnp.asarray(leaf, jnp.float32)
+        return jnp.asarray(leaf, policy.cast_model_type)
+
+    return jax.tree_util.tree_map_with_path(_cast, params)
+
+
+def _is_float_array(a) -> bool:
+    """True for jax *and* numpy array leaves with a floating dtype (numpy
+    params arrive from checkpoint loaders and must be cast too)."""
+    return hasattr(a, "dtype") and hasattr(a, "shape") and jnp.issubdtype(a.dtype, jnp.floating)
+
+
+def upcast_params(params, dtype=jnp.float32):
+    """Cast all floating leaves up (master-weight init; fp16util.py:100-126)."""
+    return jax.tree.map(
+        lambda a: jnp.asarray(a, dtype) if _is_float_array(a) else a, params
+    )

@@ -1,0 +1,55 @@
+"""Rank-aware library logging.
+
+Reference: apex/__init__.py:27-39 installs a ``RankInfoFormatter`` injecting the
+(dp, tp, pp, vpp) rank tuple into every record (rank info from
+apex/transformer/parallel_state.py:186-195, apex/transformer/log_util.py).
+Here ranks come from ``jax.process_index`` and the active parallel context.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+class RankInfoFilter(logging.Filter):
+    def filter(self, record):
+        try:
+            import jax
+
+            record.rank = jax.process_index()
+        except Exception:
+            record.rank = 0
+        try:
+            from apex_tpu.transformer import parallel_state
+
+            record.rank_info = parallel_state.get_rank_info_str()
+        except Exception:
+            record.rank_info = ""
+        return True
+
+
+def get_logger(name: str = "apex_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s [proc %(rank)s%(rank_info)s] %(name)s: %(message)s"
+            )
+        )
+        handler.addFilter(RankInfoFilter())
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+def maybe_print(msg: str, rank0: bool = False):
+    """Print helper mirroring apex/amp/_amp_state.py:39-51."""
+    try:
+        import jax
+
+        if rank0 and jax.process_index() != 0:
+            return
+    except Exception:
+        pass
+    print(msg, flush=True)

@@ -1,0 +1,107 @@
+"""amp end-to-end: O2 master weights, loss scaling, overflow skip.
+
+Mirrors tests/L0/run_amp (checkpointing, master-param coherence) and
+tests/distributed/amp_master_params (masters == model.half() invariant).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_sgd
+
+
+def _model():
+    def apply_fn(params, x):
+        h = x @ params["w1"].astype(x.dtype)
+        h = jax.nn.relu(h)
+        return h @ params["w2"].astype(x.dtype)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {
+        "w1": jax.random.normal(k1, (8, 16), jnp.float32) * 0.1,
+        "w2": jax.random.normal(k2, (16, 4), jnp.float32) * 0.1,
+    }
+    return apply_fn, params
+
+
+def test_initialize_o2_casts_and_bundles():
+    apply_fn, params = _model()
+    ts = amp.initialize(
+        params, fused_sgd(lr=0.1, momentum=0.9), opt_level="O2", apply_fn=apply_fn
+    )
+    assert ts.params["w1"].dtype == jnp.bfloat16
+    assert ts.opt_state.master["w1"].dtype == jnp.float32
+    assert ts.scaler.dynamic
+
+
+def test_o2_train_step_decreases_loss():
+    apply_fn, params = _model()
+    ts = amp.initialize(
+        params, fused_sgd(lr=0.05, momentum=0.9), opt_level="O2", apply_fn=apply_fn
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 8), jnp.bfloat16)
+    y = jax.random.normal(jax.random.PRNGKey(2), (32, 4), jnp.float32)
+
+    @jax.jit
+    def step(ts, x, y):
+        def loss_fn(p):
+            pred = ts.apply_fn(p, x)
+            loss = jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+            return ts.scale_loss(loss), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(ts.params)
+        ts, metrics = ts.apply_gradients(grads)
+        return ts, loss, metrics
+
+    losses = []
+    for _ in range(20):
+        ts, loss, metrics = step(ts, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9
+    assert not bool(metrics["found_inf"])
+    # master/model coherence: model params == masters cast down
+    for m, p in zip(jax.tree.leaves(ts.opt_state.master), jax.tree.leaves(ts.params)):
+        np.testing.assert_array_equal(
+            np.asarray(m.astype(jnp.bfloat16)), np.asarray(p)
+        )
+
+
+def test_overflow_skips_step_and_halves_scale():
+    apply_fn, params = _model()
+    ts = amp.initialize(
+        params, fused_sgd(lr=0.1), opt_level="O2", apply_fn=apply_fn
+    )
+    scale_before = float(ts.scaler.loss_scale)
+    params_before = jax.tree.map(np.asarray, ts.params)
+
+    bad_grads = jax.tree.map(lambda p: jnp.full_like(p, jnp.inf), ts.params)
+    ts, metrics = ts.apply_gradients(bad_grads)
+
+    assert bool(metrics["found_inf"])
+    assert float(ts.scaler.loss_scale) == scale_before / 2
+    for before, after in zip(
+        jax.tree.leaves(params_before), jax.tree.leaves(ts.params)
+    ):
+        np.testing.assert_array_equal(before, np.asarray(after))
+
+
+def test_o0_passthrough():
+    apply_fn, params = _model()
+    ts = amp.initialize(params, fused_sgd(lr=0.1), opt_level="O0", apply_fn=apply_fn)
+    assert ts.params["w1"].dtype == jnp.float32
+    assert ts.opt_state.master is None
+    assert not ts.scaler.dynamic
+
+
+def test_state_dict_roundtrip():
+    apply_fn, params = _model()
+    ts = amp.initialize(params, fused_sgd(lr=0.1), opt_level="O2", apply_fn=apply_fn)
+    bad_grads = jax.tree.map(lambda p: jnp.full_like(p, jnp.inf), ts.params)
+    ts, _ = ts.apply_gradients(bad_grads)
+    payload = ts.mp_optimizer.state_dict(ts.opt_state)
+
+    ts2 = amp.initialize(params, fused_sgd(lr=0.1), opt_level="O2", apply_fn=apply_fn)
+    restored = ts2.mp_optimizer.load_state_dict(ts2.opt_state, payload)
+    assert float(restored.scaler.loss_scale) == float(ts.scaler.loss_scale)

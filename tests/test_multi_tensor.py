@@ -1,0 +1,64 @@
+"""Tree-level fused ops vs numpy (reference: tests/L0/run_amp/test_multi_tensor_*)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops import (
+    tree_axpby,
+    tree_l2norm,
+    tree_l2norm_per_tensor,
+    tree_nonfinite,
+    tree_scale,
+)
+from apex_tpu.ops.multi_tensor import tree_clip_by_global_norm
+
+
+def _tree():
+    return {
+        "a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+        "b": {"c": jnp.ones((4,), jnp.bfloat16) * 2},
+    }
+
+
+def test_tree_scale():
+    out, inf = tree_scale(_tree(), 0.5)
+    assert not bool(inf)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.arange(6).reshape(2, 3) * 0.5)
+    assert out["b"]["c"].dtype == jnp.bfloat16
+
+
+def test_tree_scale_overflow_flag():
+    t = _tree()
+    t["a"] = t["a"].at[0, 0].set(jnp.nan)
+    _, inf = tree_scale(t, 1.0)
+    assert bool(inf)
+
+
+def test_tree_axpby():
+    x = {"w": jnp.array([1.0, 2.0])}
+    y = {"w": jnp.array([10.0, 20.0])}
+    out, inf = tree_axpby(2.0, x, 0.5, y)
+    assert not bool(inf)
+    np.testing.assert_allclose(np.asarray(out["w"]), [7.0, 14.0])
+
+
+def test_tree_l2norm():
+    t = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+    assert float(tree_l2norm(t)) == 5.0
+    per = tree_l2norm_per_tensor(t)
+    assert float(per["a"]) == 3.0 and float(per["b"]) == 4.0
+
+
+def test_tree_nonfinite():
+    assert not bool(tree_nonfinite(_tree()))
+    assert bool(tree_nonfinite({"x": jnp.array([jnp.inf])}))
+
+
+def test_clip_by_global_norm():
+    t = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+    clipped, gnorm = tree_clip_by_global_norm(t, 1.0)
+    assert abs(float(gnorm) - 5.0) < 1e-5
+    total = np.sqrt(
+        np.asarray(clipped["a"]) ** 2 + np.asarray(clipped["b"]) ** 2
+    ).item()
+    assert abs(total - 1.0) < 1e-4

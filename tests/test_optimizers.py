@@ -1,0 +1,156 @@
+"""Fused optimizers vs reference implementations.
+
+Mirrors tests/L0/run_optimizers/test_fused_optimizer.py (FusedAdam etc. vs
+torch.optim references) using optax/numpy references instead.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.optimizers import (
+    FusedAdam,
+    fused_adagrad,
+    fused_adam,
+    fused_lamb,
+    fused_novograd,
+    fused_sgd,
+    larc,
+)
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "w": jax.random.normal(k, (4, 3), jnp.float32),
+        "b": jnp.zeros((3,), jnp.float32),
+    }
+
+
+def _grads(seed=1):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "w": jax.random.normal(k, (4, 3), jnp.float32) * 0.1,
+        "b": jax.random.normal(jax.random.fold_in(k, 1), (3,), jnp.float32) * 0.1,
+    }
+
+
+def _run(tx, params, steps=5, **kw):
+    state = tx.init(params)
+    for i in range(steps):
+        updates, state = tx.update(_grads(i), state, params, **kw)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
+def test_fused_adam_matches_optax_adamw():
+    lr, wd = 1e-2, 0.1
+    p1 = _run(fused_adam(lr=lr, weight_decay=wd, adam_w_mode=True), _params())
+    ref = optax.adamw(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+    p2 = _run(
+        optax.GradientTransformation(
+            ref.init, lambda g, s, p=None: ref.update(g, s, p)
+        ),
+        _params(),
+    )
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
+def test_fused_adam_l2_mode_matches_optax_adam():
+    lr, wd = 1e-2, 0.1
+    p1 = _run(fused_adam(lr=lr, weight_decay=wd, adam_w_mode=False), _params())
+
+    def ref_update(g, s, p):
+        g = jax.tree.map(lambda gi, pi: gi + wd * pi, g, p)
+        ref = optax.adam(lr)
+        return ref.update(g, s, p)
+
+    ref = optax.adam(lr)
+    p2 = _run(optax.GradientTransformation(ref.init, ref_update), _params())
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
+def test_fused_sgd_matches_optax_momentum():
+    lr, mom = 1e-2, 0.9
+    p1 = _run(fused_sgd(lr=lr, momentum=mom), _params())
+    ref = optax.sgd(lr, momentum=mom)
+    p2 = _run(
+        optax.GradientTransformation(ref.init, lambda g, s, p=None: ref.update(g, s, p)),
+        _params(),
+    )
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_sgd_nesterov_runs():
+    p = _run(fused_sgd(lr=1e-2, momentum=0.9, nesterov=True), _params())
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in jax.tree.leaves(p))
+
+
+def test_fused_lamb_trust_ratio_moves_params():
+    params = _params()
+    p = _run(fused_lamb(lr=1e-2, weight_decay=0.01), params)
+    # params changed and stayed finite
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(params)):
+        assert np.all(np.isfinite(np.asarray(a)))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_fused_lamb_no_wd_no_nvlamb_is_adam_like():
+    # with weight_decay=0 and use_nvlamb=False the trust ratio is 1 → plain
+    # clipped Adam; compare against fused_adam with matching grad clip off.
+    p1 = _run(fused_lamb(lr=1e-3, weight_decay=0.0, max_grad_norm=0.0, eps=1e-8), _params())
+    p2 = _run(fused_adam(lr=1e-3, weight_decay=0.0, eps=1e-8), _params())
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_novograd_runs_and_differs_from_adam():
+    p1 = _run(fused_novograd(lr=1e-2), _params())
+    p2 = _run(fused_adam(lr=1e-2), _params())
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in jax.tree.leaves(p1))
+    assert not np.allclose(
+        np.asarray(jax.tree.leaves(p1)[0]), np.asarray(jax.tree.leaves(p2)[0])
+    )
+
+
+def test_fused_adagrad_matches_manual():
+    lr, eps = 0.1, 1e-10
+    params = {"w": jnp.array([1.0, 2.0])}
+    tx = fused_adagrad(lr=lr, eps=eps)
+    state = tx.init(params)
+    g = {"w": jnp.array([0.5, -0.5])}
+    updates, state = tx.update(g, state, params)
+    new = optax.apply_updates(params, updates)
+    h = 0.25
+    expected = np.array([1.0, 2.0]) - lr * np.array([0.5, -0.5]) / (np.sqrt(h) + eps)
+    np.testing.assert_allclose(np.asarray(new["w"]), expected, rtol=1e-6)
+
+
+def test_larc_clips_adaptive_lr():
+    base = fused_sgd(lr=0.1)
+    tx = larc(base, trust_coefficient=0.02, clip=True, base_lr=0.1)
+    params = _params()
+    p = _run(tx, params)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in jax.tree.leaves(p))
+
+
+def test_class_api():
+    opt = FusedAdam(lr=1e-3)
+    params = _params()
+    state = opt.init(params)
+    updates, state = opt.update(_grads(), state, params)
+    new = optax.apply_updates(params, updates)
+    assert not np.allclose(np.asarray(new["w"]), np.asarray(params["w"]))
+
+
+def test_lr_schedule_via_lr_t():
+    tx = fused_adam(lr=1.0)
+    params = _params()
+    state = tx.init(params)
+    u1, _ = tx.update(_grads(), state, params, lr_t=0.0)
+    assert all(np.allclose(np.asarray(l), 0.0) for l in jax.tree.leaves(u1))

@@ -1,0 +1,63 @@
+"""Policy presets + param casting (reference: tests/L0/run_amp casting tests)."""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import precision
+
+
+def test_opt_level_presets():
+    o0 = precision.get_policy("O0")
+    assert o0.cast_model_type is None
+    assert o0.compute_dtype == jnp.float32
+    assert not o0.master_weights
+    assert o0.loss_scale == 1.0
+
+    o1 = precision.get_policy("O1")
+    assert o1.cast_model_type is None
+    assert o1.compute_dtype == jnp.bfloat16
+    assert o1.dynamic_loss_scale
+
+    o2 = precision.get_policy("O2")
+    assert o2.cast_model_type == jnp.bfloat16
+    assert o2.master_weights
+    assert o2.keep_batchnorm_fp32
+
+    o3 = precision.get_policy("O3")
+    assert o3.cast_model_type == jnp.bfloat16
+    assert not o3.master_weights
+    assert not o3.keep_batchnorm_fp32
+
+
+def test_overrides_and_fp16():
+    p = precision.get_policy("O2", half_dtype=jnp.float16, loss_scale=128.0)
+    assert p.cast_model_type == jnp.float16
+    assert p.loss_scale == 128.0
+    assert not p.dynamic_loss_scale
+
+
+def test_bad_opt_level():
+    with pytest.raises(ValueError):
+        precision.get_policy("O4")
+
+
+def test_cast_params_keeps_norms_fp32():
+    params = {
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32)},
+        "layernorm_0": {"scale": jnp.ones((4,), jnp.float32)},
+    }
+    o2 = precision.get_policy("O2")
+    cast = precision.cast_params(params, o2)
+    assert cast["dense"]["kernel"].dtype == jnp.bfloat16
+    assert cast["layernorm_0"]["scale"].dtype == jnp.float32
+
+    o3 = precision.get_policy("O3")
+    cast3 = precision.cast_params(params, o3)
+    assert cast3["layernorm_0"]["scale"].dtype == jnp.bfloat16
+
+
+def test_op_dtype_lists():
+    o1 = precision.get_policy("O1")
+    assert o1.op_dtype("matmul") == jnp.bfloat16
+    assert o1.op_dtype("softmax") == jnp.float32
+    assert o1.op_dtype("cross_entropy") == jnp.float32

@@ -1,0 +1,61 @@
+"""Dynamic loss scaler state machine (reference: tests/L0/run_amp, scaler.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp import LossScaler
+
+
+def test_static_scale_never_changes():
+    s = LossScaler.create(128.0)
+    assert float(s.loss_scale) == 128.0
+    s2 = s.update(jnp.asarray(True))
+    assert float(s2.loss_scale) == 128.0
+
+
+def test_dynamic_halves_on_overflow():
+    s = LossScaler.create("dynamic")
+    assert float(s.loss_scale) == 2.0 ** 16
+    s2 = s.update(jnp.asarray(True))
+    assert float(s2.loss_scale) == 2.0 ** 15
+    assert int(s2.unskipped) == 0
+
+
+def test_dynamic_doubles_after_window():
+    s = LossScaler.create("dynamic", init_scale=4.0, scale_window=3)
+    for _ in range(3):
+        s = s.update(jnp.asarray(False))
+    assert float(s.loss_scale) == 8.0
+    assert int(s.unskipped) == 0
+
+
+def test_min_max_caps():
+    s = LossScaler.create("dynamic", init_scale=2.0, min_loss_scale=1.0)
+    for _ in range(5):
+        s = s.update(jnp.asarray(True))
+    assert float(s.loss_scale) == 1.0
+
+    s = LossScaler.create("dynamic", init_scale=2.0 ** 24, scale_window=1)
+    s = s.update(jnp.asarray(False))
+    assert float(s.loss_scale) == 2.0 ** 24
+
+
+def test_unscale_detects_inf():
+    s = LossScaler.create(2.0)
+    grads = {"w": jnp.array([2.0, 4.0]), "b": jnp.array([jnp.inf])}
+    unscaled, found = s.unscale(grads)
+    assert bool(found)
+    np.testing.assert_allclose(np.asarray(unscaled["w"]), [1.0, 2.0])
+
+
+def test_scale_loss():
+    s = LossScaler.create(8.0)
+    assert float(s.scale(jnp.asarray(2.0, jnp.bfloat16))) == 16.0
+
+
+def test_state_dict_roundtrip():
+    s = LossScaler.create("dynamic")
+    s = s.update(jnp.asarray(True))
+    payload = s.state_dict()
+    s2 = LossScaler.create("dynamic").load_state_dict(payload)
+    assert float(s2.loss_scale) == float(s.loss_scale)
