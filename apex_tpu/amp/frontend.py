@@ -219,11 +219,15 @@ def initialize(
 ):
     """TPU-native ``amp.initialize`` (reference: apex/amp/frontend.py:195-358).
 
-    Args mirror the reference's keyword surface where meaningful. Returns
-    ``(cast_params, mp_optimizer)`` — or, when ``apply_fn`` is given, an
-    :class:`AmpTrainState`. ``optimizers`` may be a single optax transform /
-    ClassOptimizer or None (inference only, like the reference's
-    optimizers=None path, _initialize.py:220-222).
+    Args mirror the reference's keyword surface where meaningful.
+    ``optimizers`` may be a single optax transform / ClassOptimizer, or None
+    for inference-only use (the reference's optimizers=None path,
+    _initialize.py:220-222).
+
+    Returns:
+      - with an optimizer and ``apply_fn``: an :class:`AmpTrainState`;
+      - with an optimizer, no ``apply_fn``: ``(cast_params, mp_optimizer)``;
+      - with ``optimizers=None``: ``(cast_params, policy)``.
     """
     policy = _precision.get_policy(
         opt_level,
@@ -245,6 +249,12 @@ def initialize(
 
     cast = _precision.cast_params(params, policy)
     if optimizers is None:
+        if apply_fn is not None:
+            raise ValueError(
+                "apply_fn without an optimizer has nothing to train; call "
+                "initialize(params, opt_level=...) for inference casting, or "
+                "pass an optimizer to build an AmpTrainState."
+            )
         return cast, policy
 
     mp_opt = MixedPrecisionOptimizer(

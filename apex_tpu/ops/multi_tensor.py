@@ -23,11 +23,8 @@ import jax.numpy as jnp
 
 def _float_leaves(tree):
     # matches jax arrays, numpy arrays, and python/np floats alike
-    return [
-        l
-        for l in jax.tree.leaves(tree)
-        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
-    ]
+    leaves = [jnp.asarray(l) for l in jax.tree.leaves(tree)]
+    return [l for l in leaves if jnp.issubdtype(l.dtype, jnp.inexact)]
 
 
 def tree_nonfinite(tree) -> jax.Array:
@@ -54,6 +51,7 @@ def tree_scale(tree, scale, out_dtype=None) -> Tuple[Any, jax.Array]:
     found_inf = tree_nonfinite(tree)
 
     def _scale(l):
+        l = jnp.asarray(l)
         if not jnp.issubdtype(l.dtype, jnp.inexact):
             return l
         out = l.astype(jnp.float32) * scale
@@ -72,9 +70,10 @@ def tree_axpby(a, x_tree, b, y_tree, out_dtype=None) -> Tuple[Any, jax.Array]:
     found_inf = jnp.logical_or(tree_nonfinite(x_tree), tree_nonfinite(y_tree))
 
     def _axpby(x, y):
+        x = jnp.asarray(x)
         if not jnp.issubdtype(x.dtype, jnp.inexact):
             return x
-        out = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        out = a * x.astype(jnp.float32) + b * jnp.asarray(y).astype(jnp.float32)
         return out.astype(out_dtype or x.dtype)
 
     return jax.tree.map(_axpby, x_tree, y_tree), found_inf
@@ -100,8 +99,8 @@ def tree_l2norm_per_tensor(tree):
     (apex/optimizers/fused_novograd.py) and LAMB trust ratios.
     """
     return jax.tree.map(
-        lambda l: jnp.sqrt(jnp.sum(jnp.square(l.astype(jnp.float32))))
-        if jnp.issubdtype(l.dtype, jnp.inexact)
+        lambda l: jnp.sqrt(jnp.sum(jnp.square(jnp.asarray(l).astype(jnp.float32))))
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
         else l,
         tree,
     )
@@ -112,7 +111,11 @@ def tree_clip_by_global_norm(tree, max_norm: float):
     apex/fp16_utils/fp16_optimizer.py:386-407)."""
     gnorm = tree_l2norm(tree)
     factor = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
-    return jax.tree.map(
-        lambda l: (l * factor).astype(l.dtype) if jnp.issubdtype(l.dtype, jnp.inexact) else l,
-        tree,
-    ), gnorm
+
+    def _clip(l):
+        l = jnp.asarray(l)
+        if not jnp.issubdtype(l.dtype, jnp.inexact):
+            return l
+        return (l * factor).astype(l.dtype)
+
+    return jax.tree.map(_clip, tree), gnorm

@@ -42,8 +42,11 @@ class ClassOptimizer:
         params = optax.apply_updates(params, updates)
     """
 
-    def __init__(self, transform: optax.GradientTransformation):
+    def __init__(self, transform: optax.GradientTransformation, lr: float = None):
         self._tx = transform
+        #: The construction-time learning rate, exposed for wrappers that need
+        #: it (the reference reads group['lr'] live, e.g. LARC.py:96).
+        self.lr = lr
 
     def init(self, params):
         return self._tx.init(params)

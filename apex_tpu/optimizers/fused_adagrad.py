@@ -62,5 +62,6 @@ def fused_adagrad(
 class FusedAdagrad(ClassOptimizer):
     def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0, adagrad_w_mode=False, **_ignored):
         super().__init__(
-            fused_adagrad(lr=lr, eps=eps, weight_decay=weight_decay, adagrad_w_mode=adagrad_w_mode)
+            fused_adagrad(lr=lr, eps=eps, weight_decay=weight_decay, adagrad_w_mode=adagrad_w_mode),
+            lr=lr,
         )

@@ -103,5 +103,6 @@ class FusedAdam(ClassOptimizer):
                 adam_w_mode=adam_w_mode,
                 bias_correction=bias_correction,
                 amsgrad=amsgrad,
-            )
+            ),
+            lr=lr,
         )

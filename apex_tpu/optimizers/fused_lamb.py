@@ -123,5 +123,6 @@ class FusedLAMB(ClassOptimizer):
                 adam_w_mode=adam_w_mode,
                 max_grad_norm=max_grad_norm,
                 use_nvlamb=use_nvlamb,
-            )
+            ),
+            lr=lr,
         )

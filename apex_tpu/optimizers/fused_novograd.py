@@ -109,5 +109,6 @@ class FusedNovoGrad(ClassOptimizer):
                 init_zero=init_zero,
                 reg_inside_moment=reg_inside_moment,
                 bias_correction=bias_correction,
-            )
+            ),
+            lr=lr,
         )

@@ -96,5 +96,6 @@ class FusedSGD(ClassOptimizer):
                 weight_decay=weight_decay,
                 nesterov=nesterov,
                 wd_after_momentum=wd_after_momentum,
-            )
+            ),
+            lr=lr,
         )

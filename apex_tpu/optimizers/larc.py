@@ -9,6 +9,8 @@ a multiplicative scale. Implemented here as an optax chain-style wrapper.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -22,13 +24,21 @@ def larc(
     clip: bool = True,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
-    base_lr: float = 1.0,
+    base_lr: Optional[float] = None,
 ) -> optax.GradientTransformation:
     """Wrap ``inner`` with LARC grad rescaling (LARC.py:78-104).
 
-    ``base_lr`` is the LR the inner transform will apply, needed for the
-    ``clip`` mode ratio ``min(adaptive_lr / lr, 1)``.
+    ``base_lr`` is the LR the inner transform will apply; the reference reads
+    it live from ``group['lr']`` (LARC.py:96), but an optax transform hides
+    its LR, so clip mode — ratio ``min(adaptive_lr / lr, 1)`` — requires it
+    explicitly (the ``LARC`` class fills it from ``optimizer.lr``).
     """
+    if clip and base_lr is None:
+        raise ValueError(
+            "larc(clip=True) needs base_lr (the inner optimizer's learning "
+            "rate) to form min(adaptive_lr / lr, 1); pass base_lr= or use the "
+            "LARC class with an apex_tpu fused optimizer."
+        )
 
     def init_fn(params):
         return inner.init(params)
@@ -65,9 +75,11 @@ class LARC(ClassOptimizer):
         clip=True,
         eps=1e-8,
         weight_decay=0.0,
-        base_lr=1.0,
+        base_lr=None,
     ):
         inner = optimizer.transform if isinstance(optimizer, ClassOptimizer) else optimizer
+        if base_lr is None:
+            base_lr = getattr(optimizer, "lr", None)
         super().__init__(
             larc(
                 inner,

@@ -1,0 +1,29 @@
+"""apex_tpu.parallel — mesh topology, collectives, and the data-parallel runtime.
+
+Reference: apex/parallel/ (DDP, SyncBatchNorm, LARC, multiproc) and
+apex/transformer/parallel_state.py (the "MPU"). Here both layers share one
+substrate: a named ``jax.sharding.Mesh`` whose axes replace NCCL process
+groups, and XLA collectives that replace bucketed allreduce.
+"""
+
+from apex_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_PIPE,
+    destroy_model_parallel,
+    get_context_parallel_world_size,
+    get_data_parallel_world_size,
+    get_gradient_reduction_axes,
+    get_mesh,
+    get_pipeline_model_parallel_split_rank,
+    get_pipeline_model_parallel_world_size,
+    get_tensor_model_parallel_world_size,
+    get_virtual_pipeline_model_parallel_rank,
+    get_virtual_pipeline_model_parallel_world_size,
+    initialize_model_parallel,
+    model_parallel_is_initialized,
+    rank_coords,
+    set_virtual_pipeline_model_parallel_rank,
+)
+from apex_tpu.parallel import collectives  # noqa: F401

@@ -1,0 +1,140 @@
+"""Named-axis collectives — the communication backend over ICI/DCN.
+
+Reference: apex uses torch.distributed/NCCL process-group verbs —
+``all_reduce`` (apex/parallel/distributed.py:449-451,
+apex/transformer/tensor_parallel/mappings.py:31), ``broadcast``
+(distributed.py:253,296), ``all_gather`` (mappings.py:69), batched
+``isend/irecv`` (pipeline_parallel/p2p_communication.py:29-67), with CUDA
+streams for comm/compute overlap (distributed.py:425-475). SURVEY.md §2.4.
+
+Here each verb is a thin, documented wrapper over the XLA collective that
+rides ICI/DCN: process groups become mesh axis names, streams/overlap become
+XLA's async-collective latency hiding, and point-to-point pipeline traffic
+becomes ``ppermute`` ring shifts. All of these are only meaningful inside a
+``shard_map`` (or vmapped/pjitted context) that binds the axis name.
+
+Everything is a tree-map: apex's multi-tensor bucketing (flatten → NCCL →
+unflatten, distributed.py:425-475) exists to amortize launch overhead in
+eager CUDA; XLA already coalesces collectives, so a pytree maps directly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AxisNames = Union[str, Tuple[str, ...]]
+
+
+def axis_rank(axis: AxisNames) -> jax.Array:
+    """This shard's index along ``axis`` (torch.distributed.get_rank(group)
+    equivalent, parallel_state.py:263-299)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisNames) -> int:
+    """Static size of ``axis`` (get_world_size(group) equivalent)."""
+    return lax.axis_size(axis)
+
+
+def psum(tree: Any, axis: AxisNames) -> Any:
+    """All-reduce-sum over a mesh axis (dist.all_reduce SUM)."""
+    return lax.psum(tree, axis)
+
+
+def pmean(tree: Any, axis: AxisNames) -> Any:
+    """Averaging all-reduce — the DDP gradient reduction semantic
+    (apex/parallel/distributed.py:449-457: allreduce then divide by
+    world size)."""
+    return lax.pmean(tree, axis)
+
+
+def pmax(tree: Any, axis: AxisNames) -> Any:
+    """All-reduce-max (used by vocab-parallel cross entropy,
+    tensor_parallel/cross_entropy.py:30-33, and overflow checks,
+    transformer/amp/grad_scaler.py:25-36)."""
+    return jax.tree.map(lambda x: lax.pmax(x, axis), tree)
+
+
+def all_gather(tree: Any, axis: AxisNames, *, gather_axis: int = 0, tiled: bool = True) -> Any:
+    """Gather shards along ``axis``, concatenating on ``gather_axis``
+    (dist.all_gather + cat, tensor_parallel/mappings.py:61-70)."""
+    return jax.tree.map(
+        lambda x: lax.all_gather(x, axis, axis=gather_axis, tiled=tiled), tree
+    )
+
+
+def reduce_scatter(tree: Any, axis: AxisNames, *, scatter_axis: int = 0) -> Any:
+    """Sum-reduce then scatter shards along ``scatter_axis`` — the ZeRO grad
+    primitive (contrib DistributedFusedAdam reduce-scatter pipeline,
+    distributed_fused_adam.py:397-441)."""
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True),
+        tree,
+    )
+
+
+def ppermute_shift(tree: Any, axis: AxisNames, shift: int = 1) -> Any:
+    """Ring shift: each shard sends to ``(rank + shift) % size`` — the TPU
+    replacement for batched isend/irecv pipeline p2p
+    (p2p_communication.py:29-67) and the transport for ring attention."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def broadcast(tree: Any, axis: AxisNames, src: int = 0) -> Any:
+    """Broadcast ``src``'s shard to all ranks along ``axis``
+    (dist.broadcast; tensor_parallel/data.py:50, distributed.py:253)."""
+
+    def _bcast(x):
+        # all_gather then static index: XLA lowers this to a broadcast-shaped
+        # collective; avoids a host round-trip.
+        return lax.all_gather(x, axis, axis=0, tiled=False)[src]
+
+    return jax.tree.map(_bcast, tree)
+
+
+def all_to_all(
+    x: jax.Array, axis: AxisNames, *, split_axis: int, concat_axis: int
+) -> jax.Array:
+    """All-to-all reshard (basis of Ulysses-style sequence parallelism —
+    absent in the reference, SURVEY.md §2.3 row SP)."""
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers (host side)
+# ---------------------------------------------------------------------------
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def constrain(x: Any, *spec) -> Any:
+    """``with_sharding_constraint`` with a PartitionSpec — the GSPMD
+    annotation that replaces the reference's hand-written conjugate
+    collectives (mappings.py:23-159) in pjit-traced code."""
+    return jax.lax.with_sharding_constraint(x, PartitionSpec(*spec))
+
+
+def shard_map_over(
+    mesh: Mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = False,
+) -> Callable[[Callable], Callable]:
+    """Decorator sugar for ``jax.shard_map`` over ``mesh``."""
+
+    def deco(fn):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+
+    return deco

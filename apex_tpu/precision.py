@@ -31,6 +31,7 @@ but retained for parity and for fp16 experiments — pass
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, FrozenSet, Optional, Union
 
 import jax
@@ -61,6 +62,12 @@ _DEFAULT_FP32_OPS: FrozenSet[str] = frozenset(
         "softplus",
         "sigmoid_loss",
     }
+)
+
+# Normalization families — stay fp32 under keep_batchnorm_fp32 even when the
+# model is cast (O2), like apex re-floating _BatchNorm (fp16util.py:42-49).
+_NORM_OPS: FrozenSet[str] = frozenset(
+    {"batch_norm", "layer_norm", "rms_norm", "group_norm"}
 )
 
 # Op families computed in the half dtype under O1 — the FP16 whitelist
@@ -112,10 +119,26 @@ class Policy:
         return self.cast_model_type or jnp.dtype(jnp.float32)
 
     def op_dtype(self, op_family: str) -> jnp.dtype:
-        """Compute dtype for an op family under this policy (O1 semantics):
-        blacklisted families are fp32, everything else (the whitelist and
-        promote-list) follows ``compute_dtype``."""
-        if op_family in self.fp32_ops:
+        """Compute dtype for an op family under this policy.
+
+        With uncast (fp32) params — O0/O1 — the op lists govern:
+        blacklisted families are fp32, whitelisted families follow
+        ``compute_dtype``, and families on neither list stay fp32 (the
+        conservative reading of the reference's promote/passthrough lists:
+        under O1 inputs derive from fp32 params, so type promotion resolves
+        to fp32; apex/amp/lists/torch_overrides.py:63-115).
+
+        With a cast model — O2/O3 — the whole network runs in
+        ``compute_dtype`` (the reference casts the model wholesale,
+        _initialize.py:176-182) except normalization families when
+        ``keep_batchnorm_fp32`` asks for fp32 norms (frontend.py:150-162)."""
+        if self.cast_model_type is None:
+            if op_family in self.fp32_ops:
+                return jnp.dtype(jnp.float32)
+            if op_family in self.half_ops:
+                return self.compute_dtype
+            return jnp.dtype(jnp.float32)
+        if self.keep_batchnorm_fp32 and op_family in _NORM_OPS:
             return jnp.dtype(jnp.float32)
         return self.compute_dtype
 
@@ -198,19 +221,29 @@ def get_policy(opt_level: Union[str, Policy] = "O1", **overrides) -> Policy:
 # Param-tree casting helpers (replace convert_network, fp16util.py:35-99)
 # ---------------------------------------------------------------------------
 
-# Module-path substrings that mark normalization layers (kept fp32 under
-# keep_batchnorm_fp32, like apex's _BatchNorm re-float, fp16util.py:42-49).
-_NORM_KEY_MARKERS = ("norm", "bn_", "batchnorm", "layernorm")
+# Module-path patterns that mark normalization layers (kept fp32 under
+# keep_batchnorm_fp32, like apex's _BatchNorm re-float, fp16util.py:42-49):
+# any name containing "norm" (batchnorm, layernorm, BatchNorm_0, norm1, ...)
+# or a standalone bn token ("bn", "bn1", "bn_2", "downsample_bn").
+_BN_TOKEN_RE = re.compile(r"(^|[._/])bn\d*([._/]|$)")
+
+
+def _name_is_norm(name: str) -> bool:
+    n = name.lower()
+    return "norm" in n or _BN_TOKEN_RE.search(n) is not None
 
 
 def _path_is_norm(path) -> bool:
-    names = []
     for p in path:
         if hasattr(p, "key"):
-            names.append(str(p.key).lower())
+            name = str(p.key)
         elif hasattr(p, "name"):
-            names.append(str(p.name).lower())
-    return any(m in n for n in names for m in _NORM_KEY_MARKERS)
+            name = str(p.name)
+        else:
+            continue
+        if _name_is_norm(name):
+            return True
+    return False
 
 
 def cast_params(params, policy: Policy):

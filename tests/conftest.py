@@ -16,4 +16,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The env vars above are insufficient when a sitecustomize has already
+# registered an accelerator plugin (e.g. the axon TPU tunnel) at interpreter
+# start; platform *selection* only happens at first backend use, so a config
+# update here still wins.
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 jax.config.update("jax_default_matmul_precision", "highest")

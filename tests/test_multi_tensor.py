@@ -62,3 +62,12 @@ def test_clip_by_global_norm():
         np.asarray(clipped["a"]) ** 2 + np.asarray(clipped["b"]) ** 2
     ).item()
     assert abs(total - 1.0) < 1e-4
+
+
+def test_tree_scale_scalar_leaves():
+    """Regression: python-float leaves must not crash tree ops."""
+    from apex_tpu.ops.multi_tensor import tree_scale
+
+    out, inf = tree_scale({"w": jnp.ones(3), "aux": 0.5}, 2.0)
+    assert float(out["aux"]) == 1.0
+    assert not bool(inf)

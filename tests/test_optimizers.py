@@ -154,3 +154,18 @@ def test_lr_schedule_via_lr_t():
     state = tx.init(params)
     u1, _ = tx.update(_grads(), state, params, lr_t=0.0)
     assert all(np.allclose(np.asarray(l), 0.0) for l in jax.tree.leaves(u1))
+
+
+def test_larc_clip_requires_base_lr():
+    """Regression: clip mode must use the inner optimizer's real lr."""
+    import pytest
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.optimizers.larc import LARC, larc
+
+    with pytest.raises(ValueError):
+        larc(FusedSGD(lr=0.1).transform, clip=True)
+    wrapped = LARC(FusedSGD(lr=0.1))  # picks up lr from the optimizer
+    p = {"w": jnp.ones(4)}
+    s = wrapped.init(p)
+    u, _ = wrapped.update({"w": jnp.full(4, 0.01)}, s, p)
+    assert jnp.all(jnp.isfinite(u["w"]))

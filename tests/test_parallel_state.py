@@ -1,0 +1,114 @@
+"""Mesh topology tests — parity with the reference's rank arithmetic.
+
+Models tests/L0/run_transformer/run_initialize_test.py: after
+initialize_model_parallel(tp, pp), ranks must land in the documented groups
+(TP contiguous, DP strided by tp, PP strided widest —
+apex/transformer/parallel_state.py:119-184).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu import parallel
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    parallel.destroy_model_parallel()
+
+
+def test_requires_initialization():
+    parallel.destroy_model_parallel()
+    assert not parallel.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        parallel.get_mesh()
+
+
+def test_world_size_divisibility():
+    with pytest.raises(RuntimeError):
+        parallel.initialize_model_parallel(tensor_model_parallel_size=3)
+
+
+@pytest.mark.parametrize(
+    "tp,pp,cp",
+    [(1, 1, 1), (2, 1, 1), (2, 2, 1), (4, 2, 1), (2, 1, 2), (1, 4, 1), (2, 2, 2)],
+)
+def test_axis_sizes(tp, pp, cp):
+    parallel.initialize_model_parallel(
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        context_parallel_size=cp,
+    )
+    world = len(jax.devices())
+    assert parallel.get_tensor_model_parallel_world_size() == tp
+    assert parallel.get_pipeline_model_parallel_world_size() == pp
+    assert parallel.get_context_parallel_world_size() == cp
+    assert parallel.get_data_parallel_world_size() == world // (tp * pp * cp)
+
+
+def test_rank_placement_contract():
+    """TP contiguous; DP strides by tp within a pipe block; PP strides widest
+    (parallel_state.py:119-164). With tp=2, pp=2 on 8 devices: TP groups are
+    {0,1},{2,3},...; DP groups stride 2: {0,2},{1,3},{4,6},{5,7}; PP groups
+    stride 4: {0,4},{1,5},{2,6},{3,7}."""
+    parallel.initialize_model_parallel(
+        tensor_model_parallel_size=2, pipeline_model_parallel_size=2
+    )
+    coords = [parallel.rank_coords(r) for r in range(8)]
+    # TP partners (same p,d,c; differing m) are adjacent ranks.
+    assert coords[0][:3] == coords[1][:3] and coords[0][3] == 0 and coords[1][3] == 1
+    # DP partners differ only in d and sit tp apart.
+    p0, d0, c0, m0 = coords[0]
+    p2, d2, c2, m2 = coords[2]
+    assert (p0, c0, m0) == (p2, c2, m2) and d0 != d2
+    # PP partners differ only in p and sit tp*dp apart.
+    p4, d4, c4, m4 = coords[4]
+    assert (d0, c0, m0) == (d4, c4, m4) and p0 == 0 and p4 == 1
+    # Mesh device grid matches the flat order.
+    mesh = parallel.get_mesh()
+    flat = np.asarray(mesh.devices, dtype=object).reshape(-1)
+    assert [d.id for d in flat] == [d.id for d in jax.devices()]
+
+
+def test_embedding_stages_and_predicates():
+    parallel.initialize_model_parallel(pipeline_model_parallel_size=4)
+    assert mesh_lib.embedding_stages() == [0, 3]
+    assert mesh_lib.is_pipeline_first_stage(0)
+    assert not mesh_lib.is_pipeline_first_stage(1)
+    assert mesh_lib.is_pipeline_last_stage(3)
+    parallel.destroy_model_parallel()
+    parallel.initialize_model_parallel(
+        pipeline_model_parallel_size=4, pipeline_model_parallel_split_rank=2
+    )
+    assert mesh_lib.embedding_stages() == [0, 2, 3]
+
+
+def test_virtual_pipeline_state():
+    """Interleaved-schedule chunk state (parallel_state.py:367-382)."""
+    with pytest.raises(RuntimeError):
+        parallel.initialize_model_parallel(
+            pipeline_model_parallel_size=1, virtual_pipeline_model_parallel_size=2
+        )
+    parallel.initialize_model_parallel(
+        pipeline_model_parallel_size=2, virtual_pipeline_model_parallel_size=2
+    )
+    assert parallel.get_virtual_pipeline_model_parallel_world_size() == 2
+    assert parallel.get_virtual_pipeline_model_parallel_rank() == 0
+    # first/last predicates honor the virtual rank (parallel_state.py:308-330)
+    assert mesh_lib.is_pipeline_first_stage(0)
+    assert not mesh_lib.is_pipeline_last_stage(1)  # vpp rank 0 is not last chunk
+    parallel.set_virtual_pipeline_model_parallel_rank(1)
+    assert not mesh_lib.is_pipeline_first_stage(0)
+    assert mesh_lib.is_pipeline_last_stage(1)
+    assert mesh_lib.is_pipeline_last_stage(1, ignore_virtual=False) is True
+    assert mesh_lib.is_pipeline_first_stage(0, ignore_virtual=True)
+
+
+def test_destroy():
+    parallel.initialize_model_parallel()
+    assert parallel.model_parallel_is_initialized()
+    parallel.destroy_model_parallel()
+    assert not parallel.model_parallel_is_initialized()

@@ -61,3 +61,32 @@ def test_op_dtype_lists():
     assert o1.op_dtype("matmul") == jnp.bfloat16
     assert o1.op_dtype("softmax") == jnp.float32
     assert o1.op_dtype("cross_entropy") == jnp.float32
+
+
+def test_bn_numbered_keys_stay_fp32():
+    """Regression: ResNet-style 'bn1'/'bn2' keys must stay fp32 under O2."""
+    from apex_tpu.precision import cast_params, get_policy
+
+    params = {
+        "conv1": {"kernel": jnp.ones((3, 3))},
+        "bn1": {"scale": jnp.ones(3), "mean": jnp.zeros(3)},
+        "downsample_bn": {"scale": jnp.ones(3)},
+        "BatchNorm_0": {"scale": jnp.ones(3)},
+    }
+    cast = cast_params(params, get_policy("O2"))
+    assert cast["conv1"]["kernel"].dtype == jnp.bfloat16
+    assert cast["bn1"]["scale"].dtype == jnp.float32
+    assert cast["downsample_bn"]["scale"].dtype == jnp.float32
+    assert cast["BatchNorm_0"]["scale"].dtype == jnp.float32
+
+
+def test_half_ops_override_is_live():
+    from apex_tpu.precision import get_policy
+
+    p = get_policy("O1", half_ops=frozenset({"matmul"}))
+    assert p.op_dtype("matmul") == jnp.bfloat16
+    assert p.op_dtype("attention") == jnp.float32  # no longer whitelisted
+    # O2: whole model in compute dtype, norms fp32
+    o2 = get_policy("O2")
+    assert o2.op_dtype("softmax") == jnp.bfloat16
+    assert o2.op_dtype("batch_norm") == jnp.float32
