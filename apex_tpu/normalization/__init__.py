@@ -1,0 +1,18 @@
+"""apex_tpu.normalization — fused LayerNorm/RMSNorm modules.
+
+Reference: apex/normalization/fused_layer_norm.py (FusedLayerNorm :204,
+FusedRMSNorm :300, MixedFused variants :398-436) over
+csrc/layer_norm_cuda_kernel.cu. Backed here by the Pallas kernels in
+apex_tpu.ops.layer_norm.
+"""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)

@@ -1,0 +1,117 @@
+"""Fused normalization modules (flax) over the Pallas kernels.
+
+Reference surface (apex/normalization/fused_layer_norm.py):
+- ``FusedLayerNorm(normalized_shape, eps, elementwise_affine)`` (:204-297)
+- ``FusedRMSNorm`` (:300-396)
+- ``MixedFusedLayerNorm/RMSNorm`` — fp16/bf16 inputs with fp32 affine params
+  (:398-436); in JAX this is just params kept fp32 while inputs arrive half,
+  which the kernels support natively (stats are always fp32).
+- functional forms ``fused_layer_norm(_affine)`` / ``fused_rms_norm(_affine)``
+  (:168-202).
+
+The reference normalizes over a trailing ``normalized_shape`` tuple; the
+kernels normalize over one trailing dim, so inputs are flattened to
+``(..., prod(normalized_shape))`` and restored — same math, contiguous
+layout.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_tpu.ops import layer_norm as _ops
+
+Shape = Union[int, Sequence[int]]
+
+
+def _canon_shape(normalized_shape: Shape) -> Tuple[int, ...]:
+    if isinstance(normalized_shape, int):
+        return (normalized_shape,)
+    return tuple(int(s) for s in normalized_shape)
+
+
+def _flatten(x, nshape):
+    n = 1
+    for s in nshape:
+        n *= s
+    if x.shape[-len(nshape) :] != nshape:
+        raise ValueError(
+            f"input trailing dims {x.shape[-len(nshape):]} != normalized_shape {nshape}"
+        )
+    return x.reshape(x.shape[: -len(nshape)] + (n,)), x.shape
+
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape: Shape, eps=1e-5, *, impl="auto"):
+    nshape = _canon_shape(normalized_shape)
+    x2, orig = _flatten(x, nshape)
+    y = _ops.layer_norm(x2, weight.reshape(-1), bias.reshape(-1), eps, impl=impl)
+    return y.reshape(orig)
+
+
+def fused_layer_norm(x, normalized_shape: Shape, eps=1e-5, *, impl="auto"):
+    nshape = _canon_shape(normalized_shape)
+    x2, orig = _flatten(x, nshape)
+    return _ops.layer_norm(x2, None, None, eps, impl=impl).reshape(orig)
+
+
+def fused_rms_norm_affine(x, weight, normalized_shape: Shape, eps=1e-5, *, impl="auto"):
+    nshape = _canon_shape(normalized_shape)
+    x2, orig = _flatten(x, nshape)
+    return _ops.rms_norm(x2, weight.reshape(-1), eps, impl=impl).reshape(orig)
+
+
+def fused_rms_norm(x, normalized_shape: Shape, eps=1e-5, *, impl="auto"):
+    nshape = _canon_shape(normalized_shape)
+    x2, orig = _flatten(x, nshape)
+    return _ops.rms_norm(x2, None, eps, impl=impl).reshape(orig)
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in FusedLayerNorm module (fused_layer_norm.py:204-297).
+
+    ``param_dtype`` defaults to fp32 — with half inputs this *is* the
+    MixedFused variant (:398-416)."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        nshape = _canon_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("scale", nn.initializers.ones, nshape, self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros, nshape, self.param_dtype)
+            return fused_layer_norm_affine(x, weight, bias, nshape, self.eps, impl=self.impl)
+        return fused_layer_norm(x, nshape, self.eps, impl=self.impl)
+
+
+class FusedRMSNorm(nn.Module):
+    """Drop-in FusedRMSNorm module (fused_layer_norm.py:300-396)."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        nshape = _canon_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("scale", nn.initializers.ones, nshape, self.param_dtype)
+            return fused_rms_norm_affine(x, weight, nshape, self.eps, impl=self.impl)
+        return fused_rms_norm(x, nshape, self.eps, impl=self.impl)
+
+
+# The Mixed variants differ from the base ones only in forcing fp32 affine
+# params with half activations (fused_layer_norm.py:398-436) — the default
+# param_dtype here. Aliases keep the reference's import surface.
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm

@@ -12,3 +12,20 @@ from apex_tpu.ops.multi_tensor import (  # noqa: F401
     tree_l2norm_per_tensor,
     tree_nonfinite,
 )
+# NOTE: the layer_norm/rms_norm *functions* are re-exported as fused_* to
+# avoid shadowing the apex_tpu.ops.layer_norm submodule name.
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    layer_norm as fused_layer_norm,
+    layer_norm_reference,
+    rms_norm as fused_rms_norm,
+    rms_norm_reference,
+)
+from apex_tpu.ops.softmax import (  # noqa: F401
+    scaled_masked_softmax,
+    scaled_masked_softmax_reference,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    softmax_cross_entropy,
+    softmax_cross_entropy_reference,
+)

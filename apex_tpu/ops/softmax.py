@@ -1,0 +1,189 @@
+"""Fused scale + mask + softmax — Pallas TPU kernel with custom VJP.
+
+Reference: csrc/megatron/scaled_masked_softmax.{cpp,h,cu} and
+scaled_upper_triang_masked_softmax.{cpp,h,cu} (~1 500 LoC of warp-level
+kernels) behind apex/transformer/functional/fused_softmax.py. Semantics:
+``softmax(scale * x  [masked to -10000 where mask])`` over the last dim,
+with a causal (upper-triangular) variant for GPT attention scores.
+
+The CUDA kernels cap sk ≤ 2048 because a warp must hold the row
+(scaled_masked_softmax.h:80-109); here the row lives in VMEM so the envelope
+is ~64 K elements. Backward is the fused ``y * (g - Σ g·y)`` pass
+(scaled_masked_softmax_cuda backward), saving only ``y`` like the reference.
+
+Layout contract matches the reference: scores are ``(b, np, sq, sk)`` and an
+optional boolean mask is ``(b, 1, sq, sk)`` broadcast over heads
+(fused_softmax.py:67-92), True = masked out.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops.layer_norm import _interpret, _resolve_impl
+
+_MASK_FILL = -10000.0  # the reference's masked_fill value
+
+
+def _q_block(sq: int, sk: int) -> int:
+    target = max(1, (1 << 20) // max(1, sk * 4))
+    blk = max(8, min(512, (target // 8) * 8))
+    return min(blk, max(8, ((sq + 7) // 8) * 8))
+
+
+def _softmax_fwd_kernel(x_ref, mask_ref, y_ref, *, scale, causal, blk_q):
+    x = x_ref[...].astype(jnp.float32) * scale  # (1, 1|H, blk_q, sk)
+    if mask_ref is not None:
+        x = jnp.where(mask_ref[...], _MASK_FILL, x)
+    if causal:
+        qi = pl.program_id(2) if x.ndim == 4 else pl.program_id(1)
+        q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 2)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+        x = jnp.where(k_pos > q_pos, _MASK_FILL, x)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    y = e / jnp.sum(e, axis=-1, keepdims=True)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _softmax_bwd_kernel(g_ref, y_ref, dx_ref, *, scale):
+    g = g_ref[...].astype(jnp.float32)
+    y = y_ref[...].astype(jnp.float32)
+    dot = jnp.sum(g * y, axis=-1, keepdims=True)
+    dx_ref[...] = (scale * y * (g - dot)).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "causal", "has_mask"))
+def _fwd(x, mask, *, scale, causal, has_mask):
+    b, h, sq, sk = x.shape
+    blk_q = _q_block(sq, sk)
+    pad = (-sq) % blk_q
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        if has_mask:
+            mask = jnp.pad(mask, ((0, 0), (0, 0), (0, pad), (0, 0)), constant_values=True)
+    grid = (b, h, x.shape[2] // blk_q)
+
+    x_spec = pl.BlockSpec(
+        (1, 1, blk_q, sk), lambda i, j, q: (i, j, q, 0), memory_space=pltpu.VMEM
+    )
+    in_specs = [x_spec]
+    args = [x]
+    if has_mask:
+        # mask is (b, 1, sq, sk) broadcast over heads — the reference layout
+        # (fused_softmax.py:67-92) — or a full per-head (b, np, sq, sk).
+        if mask.shape[1] == h:
+            mask_idx = lambda i, j, q: (i, j, q, 0)
+        elif mask.shape[1] == 1:
+            mask_idx = lambda i, j, q: (i, 0, q, 0)
+        else:
+            raise ValueError(
+                f"mask head dim must be 1 or {h}, got {mask.shape[1]}"
+            )
+        in_specs.append(
+            pl.BlockSpec((1, 1, blk_q, sk), mask_idx, memory_space=pltpu.VMEM)
+        )
+        args.append(mask)
+
+    def kernel(*refs):
+        m_ref = refs[1] if has_mask else None
+        _softmax_fwd_kernel(
+            refs[0], m_ref, refs[-1], scale=scale, causal=causal, blk_q=blk_q
+        )
+
+    y = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(*args)
+    return y[:, :, :sq] if pad else y
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def _bwd(g, y, *, scale):
+    b, h, sq, sk = y.shape
+    blk_q = _q_block(sq, sk)
+    pad = (-sq) % blk_q
+    if pad:
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        y = jnp.pad(y, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    grid = (b, h, y.shape[2] // blk_q)
+    spec = pl.BlockSpec(
+        (1, 1, blk_q, sk), lambda i, j, q: (i, j, q, 0), memory_space=pltpu.VMEM
+    )
+    dx = pl.pallas_call(
+        functools.partial(_softmax_bwd_kernel, scale=scale),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(y.shape, y.dtype),
+        interpret=_interpret(),
+    )(g, y)
+    return dx[:, :, :sq] if pad else dx
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _scaled_masked_softmax(x, mask, scale, causal):
+    return _fwd(x, mask, scale=scale, causal=causal, has_mask=mask is not None)
+
+
+def _sms_fwd(x, mask, scale, causal):
+    y = _fwd(x, mask, scale=scale, causal=causal, has_mask=mask is not None)
+    return y, y
+
+
+def _sms_bwd(scale, causal, y, g):
+    return _bwd(g, y, scale=scale), None
+
+
+_scaled_masked_softmax.defvjp(_sms_fwd, _sms_bwd)
+
+
+def _xla_softmax(x, mask, scale, causal):
+    x = x.astype(jnp.float32) * scale
+    if mask is not None:
+        x = jnp.where(mask, _MASK_FILL, x)
+    if causal:
+        sq, sk = x.shape[-2], x.shape[-1]
+        q = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        x = jnp.where(k > q, _MASK_FILL, x)
+    return jax.nn.softmax(x, axis=-1)
+
+
+def scaled_masked_softmax(
+    x: jax.Array,
+    mask: Optional[jax.Array] = None,
+    scale: float = 1.0,
+    *,
+    impl: str = "auto",
+) -> jax.Array:
+    """``softmax(scale*x masked to -10000)`` over sk
+    (ScaledMaskedSoftmax, fused_softmax.py:67-92)."""
+    if _resolve_impl(impl) == "xla":
+        return _xla_softmax(x, mask, scale, causal=False).astype(x.dtype)
+    return _scaled_masked_softmax(x, mask, float(scale), False)
+
+
+def scaled_upper_triang_masked_softmax(
+    x: jax.Array, scale: float = 1.0, *, impl: str = "auto"
+) -> jax.Array:
+    """Causal variant (ScaledUpperTriangMaskedSoftmax, fused_softmax.py:21-46)."""
+    if _resolve_impl(impl) == "xla":
+        return _xla_softmax(x, None, scale, causal=True).astype(x.dtype)
+    return _scaled_masked_softmax(x, None, float(scale), True)
+
+
+def scaled_masked_softmax_reference(x, mask=None, scale=1.0, causal=False):
+    """Pure-XLA ground truth (the torch-softmax fallback path,
+    fused_softmax.py:176-199)."""
+    return _xla_softmax(x, mask, scale, causal).astype(x.dtype)

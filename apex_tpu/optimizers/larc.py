@@ -46,6 +46,12 @@ def larc(
     def update_fn(grads, state, params=None, **extra):
         if params is None:
             raise ValueError("larc requires params")
+        # An lr_t runtime override reaches the inner optimizer through
+        # **extra, so the clip denominator must track it (the reference reads
+        # group['lr'] live each step, LARC.py:96).
+        step_lr = extra.get("lr_t", base_lr)
+        if step_lr is None:
+            step_lr = base_lr
 
         def _rescale(g, p):
             g32 = g.astype(jnp.float32)
@@ -54,7 +60,7 @@ def larc(
             gnorm = jnp.sqrt(jnp.sum(jnp.square(g32)))
             adaptive_lr = trust_coefficient * pnorm / (gnorm + weight_decay * pnorm + eps)
             if clip:
-                adaptive_lr = jnp.minimum(adaptive_lr / base_lr, 1.0)
+                adaptive_lr = jnp.minimum(adaptive_lr / step_lr, 1.0)
             # the reference only touches the grad inside the nonzero-norms
             # branch (LARC.py:92-102): zero-grad params stay untouched.
             active = (pnorm > 0) & (gnorm > 0)
@@ -88,5 +94,6 @@ class LARC(ClassOptimizer):
                 eps=eps,
                 weight_decay=weight_decay,
                 base_lr=base_lr,
-            )
+            ),
+            lr=base_lr,
         )

@@ -20,11 +20,9 @@ eager CUDA; XLA already coalesces collectives, so a pytree maps directly.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 

@@ -35,7 +35,6 @@ schedule (see apex_tpu.transformer.pipeline_parallel).
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import List, Optional, Sequence, Tuple
 
 import jax

@@ -197,6 +197,15 @@ def _make_policy(
         if k not in cfg and k not in {"fp32_ops", "half_ops"}:
             raise ValueError(f"Unknown policy override {k!r}")
         cfg[k] = v
+    if cfg.get("cast_model_type") is not None and (
+        "fp32_ops" in overrides and overrides["fp32_ops"] is not None
+        or "half_ops" in overrides and overrides["half_ops"] is not None
+    ):
+        raise ValueError(
+            "fp32_ops/half_ops only govern uncast-model policies (O0/O1); a "
+            "cast model (O2/O3) runs wholesale in compute_dtype — use "
+            "keep_batchnorm_fp32 for fp32 norms."
+        )
     if "cast_model_type" in cfg:
         cfg["cast_model_type"] = _canon(cfg["cast_model_type"])
     if "compute_dtype" in cfg:

@@ -1,0 +1,159 @@
+"""Pallas kernel equivalence tests (interpret mode on CPU).
+
+Models the reference's kernel test strategy (SURVEY.md §4):
+tests/L0/run_fused_layer_norm/ (fused vs F.layer_norm, mixed dtypes),
+tests/L0/run_transformer/test_fused_softmax.py (fused vs torch softmax),
+and the xentropy contrib tests — here fused-Pallas vs pure-XLA reference,
+forward and backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import layer_norm as ln
+from apex_tpu.ops import softmax as sm
+from apex_tpu.ops import xentropy as xe
+
+
+def _assert_close(a, b, tol=2e-5):
+    np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32), atol=tol, rtol=tol
+    )
+
+
+@pytest.mark.parametrize("rows,hidden", [(4, 64), (37, 256), (128, 130)])
+@pytest.mark.parametrize("affine", [True, False])
+def test_layer_norm_fwd_bwd(rows, hidden, affine):
+    x = jax.random.normal(jax.random.key(0), (rows, hidden), jnp.float32)
+    w = (jax.random.normal(jax.random.key(1), (hidden,)) + 1.0) if affine else None
+    b = jax.random.normal(jax.random.key(2), (hidden,)) if affine else None
+
+    def f_p(x, w, b):
+        return jnp.sum(jnp.sin(ln.layer_norm(x, w, b, impl="pallas")))
+
+    def f_r(x, w, b):
+        return jnp.sum(jnp.sin(ln.layer_norm_reference(x, w, b)))
+
+    _assert_close(
+        ln.layer_norm(x, w, b, impl="pallas"), ln.layer_norm_reference(x, w, b)
+    )
+    if affine:
+        gp = jax.grad(f_p, argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(f_r, argnums=(0, 1, 2))(x, w, b)
+    else:
+        gp = jax.grad(f_p, argnums=(0,))(x, w, b)
+        gr = jax.grad(f_r, argnums=(0,))(x, w, b)
+    for p, r in zip(gp, gr):
+        _assert_close(p, r)
+
+
+def test_rms_norm_fwd_bwd():
+    x = jax.random.normal(jax.random.key(0), (33, 192), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (192,)) + 1.0
+    _assert_close(ln.rms_norm(x, w, impl="pallas"), ln.rms_norm_reference(x, w))
+    gp = jax.grad(lambda x, w: jnp.sum(jnp.sin(ln.rms_norm(x, w, impl="pallas"))), (0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum(jnp.sin(ln.rms_norm_reference(x, w))), (0, 1))(x, w)
+    for p, r in zip(gp, gr):
+        _assert_close(p, r)
+
+
+def test_layer_norm_mixed_dtype():
+    """bf16 input, fp32 affine — the MixedFused contract
+    (fused_layer_norm.py:398-436): output bf16, stats fp32."""
+    x = jax.random.normal(jax.random.key(0), (16, 128), jnp.bfloat16)
+    w = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.float32)
+    y = ln.layer_norm(x, w, b, impl="pallas")
+    assert y.dtype == jnp.bfloat16
+    _assert_close(y, ln.layer_norm_reference(x, w, b), tol=2e-2)
+
+
+def test_layer_norm_module():
+    from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
+
+    m = FusedLayerNorm(normalized_shape=64, impl="pallas")
+    x = jax.random.normal(jax.random.key(0), (4, 7, 64))
+    params = m.init(jax.random.key(1), x)
+    y = m.apply(params, x)
+    assert y.shape == x.shape
+    assert params["params"]["scale"].dtype == jnp.float32
+    _assert_close(y, ln.layer_norm_reference(x.reshape(-1, 64)).reshape(x.shape))
+
+    r = FusedRMSNorm(normalized_shape=(64,), impl="pallas")
+    pr = r.init(jax.random.key(1), x)
+    assert "bias" not in pr["params"]
+    _assert_close(r.apply(pr, x), ln.rms_norm_reference(x.reshape(-1, 64)).reshape(x.shape))
+
+
+def test_layer_norm_multidim_normalized_shape():
+    from apex_tpu.normalization import fused_layer_norm_affine
+
+    x = jax.random.normal(jax.random.key(0), (5, 3, 4, 8))
+    w = jnp.full((4, 8), 1.5)
+    b = jnp.full((4, 8), 0.25)
+    y = fused_layer_norm_affine(x, w, b, (4, 8), impl="pallas")
+    ref = ln.layer_norm_reference(x.reshape(5, 3, 32), w.reshape(-1), b.reshape(-1))
+    _assert_close(y, ref.reshape(x.shape))
+
+
+@pytest.mark.parametrize("scale", [1.0, 0.125])
+def test_scaled_masked_softmax(scale):
+    x = jax.random.normal(jax.random.key(0), (2, 4, 17, 33), jnp.float32)
+    mask = jax.random.bernoulli(jax.random.key(1), 0.25, (2, 1, 17, 33))
+    _assert_close(
+        sm.scaled_masked_softmax(x, mask, scale, impl="pallas"),
+        sm.scaled_masked_softmax_reference(x, mask, scale),
+    )
+    gp = jax.grad(lambda a: jnp.sum(jnp.sin(sm.scaled_masked_softmax(a, mask, scale, impl="pallas"))))(x)
+    gr = jax.grad(lambda a: jnp.sum(jnp.sin(sm.scaled_masked_softmax_reference(a, mask, scale))))(x)
+    _assert_close(gp, gr)
+
+
+def test_causal_softmax():
+    x = jax.random.normal(jax.random.key(0), (2, 2, 24, 24), jnp.float32)
+    yp = sm.scaled_upper_triang_masked_softmax(x, 0.5, impl="pallas")
+    yr = sm.scaled_masked_softmax_reference(x, None, 0.5, causal=True)
+    _assert_close(yp, yr)
+    # strictly causal: probability above the diagonal ~ 0
+    assert float(yp[0, 0, 0, 1]) < 1e-4
+    gp = jax.grad(lambda a: jnp.sum(jnp.cos(sm.scaled_upper_triang_masked_softmax(a, 0.5, impl="pallas"))))(x)
+    gr = jax.grad(lambda a: jnp.sum(jnp.cos(sm.scaled_masked_softmax_reference(a, None, 0.5, causal=True))))(x)
+    _assert_close(gp, gr)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_softmax_cross_entropy(smoothing):
+    logits = jax.random.normal(jax.random.key(0), (37, 101), jnp.float32) * 3
+    labels = jax.random.randint(jax.random.key(1), (37,), 0, 101)
+    labels = labels.at[5].set(-100)  # ignored row
+    lp = xe.softmax_cross_entropy(logits, labels, smoothing, impl="pallas")
+    lr = xe.softmax_cross_entropy_reference(logits, labels, smoothing)
+    _assert_close(lp, lr)
+    assert float(lp[5]) == 0.0
+    gp = jax.grad(lambda a: jnp.sum(xe.softmax_cross_entropy(a, labels, smoothing, impl="pallas")))(logits)
+    gr = jax.grad(lambda a: jnp.sum(xe.softmax_cross_entropy_reference(a, labels, smoothing)))(logits)
+    _assert_close(gp, gr)
+    # ignored row contributes no gradient
+    assert float(jnp.max(jnp.abs(gp[5]))) == 0.0
+
+
+def test_xentropy_batched_shape():
+    logits = jax.random.normal(jax.random.key(0), (4, 9, 64))
+    labels = jax.random.randint(jax.random.key(1), (4, 9), 0, 64)
+    out = xe.softmax_cross_entropy(logits, labels, impl="pallas")
+    assert out.shape == (4, 9)
+    _assert_close(out, xe.softmax_cross_entropy_reference(logits, labels))
+
+
+def test_per_head_mask():
+    """Regression: a full (b, np, sq, sk) mask must be honored per head."""
+    x = jax.random.normal(jax.random.key(0), (2, 3, 16, 32), jnp.float32)
+    mask = jax.random.bernoulli(jax.random.key(1), 0.3, (2, 3, 16, 32))
+    _assert_close(
+        sm.scaled_masked_softmax(x, mask, 1.0, impl="pallas"),
+        sm.scaled_masked_softmax_reference(x, mask, 1.0),
+    )
+    with pytest.raises(ValueError):
+        sm.scaled_masked_softmax(x, mask[:, :2], 1.0, impl="pallas")

@@ -169,3 +169,19 @@ def test_larc_clip_requires_base_lr():
     s = wrapped.init(p)
     u, _ = wrapped.update({"w": jnp.full(4, 0.01)}, s, p)
     assert jnp.all(jnp.isfinite(u["w"]))
+
+
+def test_larc_clip_tracks_lr_t():
+    """Regression: runtime lr_t override must drive the clip denominator."""
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.optimizers.larc import LARC
+
+    p = {"w": jnp.full(4, 10.0)}
+    g = {"w": jnp.full(4, 1e-6)}  # tiny grads -> adaptive_lr huge -> clip to 1
+    wrapped = LARC(FusedSGD(lr=1.0))
+    s = wrapped.init(p)
+    u_base, _ = wrapped.update(g, s, p)
+    u_small, _ = wrapped.update(g, s, p, lr_t=0.5)
+    # adaptive_lr clips to 1 in both; update scales with the applied lr
+    np.testing.assert_allclose(u_small["w"], 0.5 * u_base["w"], rtol=1e-6)
+    assert LARC(LARC(FusedSGD(lr=0.3))).lr == 0.3

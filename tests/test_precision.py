@@ -90,3 +90,13 @@ def test_half_ops_override_is_live():
     o2 = get_policy("O2")
     assert o2.op_dtype("softmax") == jnp.bfloat16
     assert o2.op_dtype("batch_norm") == jnp.float32
+
+
+def test_op_list_overrides_rejected_for_cast_models():
+    import pytest
+    from apex_tpu.precision import get_policy
+
+    with pytest.raises(ValueError):
+        get_policy("O2", fp32_ops=frozenset({"softmax"}))
+    with pytest.raises(ValueError):
+        get_policy("O3", half_ops=frozenset({"matmul"}))
