@@ -12,12 +12,14 @@ from apex_tpu.ops.multi_tensor import (  # noqa: F401
     tree_l2norm_per_tensor,
     tree_nonfinite,
 )
-# NOTE: the layer_norm/rms_norm *functions* are re-exported as fused_* to
-# avoid shadowing the apex_tpu.ops.layer_norm submodule name.
+# Kernel-level functional forms, exported as *_kernel: the reference-parity
+# names fused_layer_norm/fused_rms_norm live in apex_tpu.normalization with
+# the reference's (x, normalized_shape, eps) signature — re-exporting these
+# (x, weight, bias, eps) functions under the same names was a foot-gun.
 from apex_tpu.ops.layer_norm import (  # noqa: F401
-    layer_norm as fused_layer_norm,
+    layer_norm as layer_norm_kernel,
     layer_norm_reference,
-    rms_norm as fused_rms_norm,
+    rms_norm as rms_norm_kernel,
     rms_norm_reference,
 )
 from apex_tpu.ops.softmax import (  # noqa: F401

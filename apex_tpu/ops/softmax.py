@@ -42,7 +42,7 @@ def _softmax_fwd_kernel(x_ref, mask_ref, y_ref, *, scale, causal, blk_q):
     if mask_ref is not None:
         x = jnp.where(mask_ref[...], _MASK_FILL, x)
     if causal:
-        qi = pl.program_id(2) if x.ndim == 4 else pl.program_id(1)
+        qi = pl.program_id(2)  # blocks are always (1, 1|H, blk_q, sk)
         q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 2)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
         x = jnp.where(k_pos > q_pos, _MASK_FILL, x)

@@ -85,7 +85,7 @@ def _fwd(logits, labels, *, smoothing, ignore_index):
         ],
         interpret=_interpret(),
     )(logits, labels2d)
-    return loss[:rows, 0], lse
+    return loss[:rows, 0], lse[:rows]
 
 
 @functools.partial(jax.jit, static_argnames=("smoothing", "ignore_index"))
@@ -98,7 +98,7 @@ def _bwd(g, logits, labels, lse, *, smoothing, ignore_index):
         logits = jnp.pad(logits, ((0, pad), (0, 0)))
         labels = jnp.pad(labels, (0, pad), constant_values=ignore_index)
         g2d = jnp.pad(g2d, ((0, pad), (0, 0)))
-        # lse already padded from fwd
+        lse = jnp.pad(lse, ((0, pad), (0, 0)))
     labels2d = labels.astype(jnp.int32)[:, None]
     grid = (logits.shape[0] // blk,)
 

@@ -1,0 +1,18 @@
+"""apex_tpu.transformer — Megatron-style model parallelism over a device mesh.
+
+Reference: apex/transformer/ (SURVEY.md §2.1 rows transformer.*). The
+reference builds NCCL process groups and hand-written collective autograd
+functions; here the topology is a ``jax.sharding.Mesh`` (apex_tpu.parallel)
+and the collectives are named-axis lax ops inside ``shard_map`` — or GSPMD
+sharding constraints under ``pjit``.
+
+- ``parallel_state``    — alias of apex_tpu.parallel.mesh (the "MPU")
+- ``tensor_parallel``   — Column/Row parallel linear, vocab-parallel
+                          embedding + cross entropy, TP-aware PRNG
+- ``pipeline_parallel`` — 1F1B / interleaved schedules, microbatches
+- ``functional``        — fused scale-mask-softmax module
+- ``amp``               — model-parallel-aware grad scaler
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401

@@ -1,0 +1,21 @@
+"""Input-data broadcast across the TP axis (reference: tensor_parallel/data.py).
+
+The reference broadcasts batches from TP-rank-0 so all TP ranks see identical
+data (data.py:33+ ``broadcast_data``: rank 0 packs sizes + a flat int64
+buffer, others receive). In SPMD JAX the per-device batch is produced by
+sharding a global array, so replication across the TP axis is a *sharding*
+(``PartitionSpec(None)`` on the model axis) rather than a runtime send. These
+helpers cover the shard_map spelling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from apex_tpu.parallel import collectives
+from apex_tpu.parallel.mesh import AXIS_MODEL
+
+
+def broadcast_data(tree: Any, axis: str = AXIS_MODEL, src: int = 0) -> Any:
+    """Make every rank along ``axis`` hold ``src``'s copy of ``tree``."""
+    return collectives.broadcast(tree, axis, src=src)

@@ -1,0 +1,111 @@
+"""Conjugate tensor-parallel collectives (reference: tensor_parallel/mappings.py:23-159).
+
+The reference implements four autograd Functions whose forward/backward are
+conjugate collectives over the TP group. Here they are ``custom_vjp`` wrappers
+over named-axis lax collectives, valid inside a ``shard_map`` that binds the
+axis. Under pure GSPMD/pjit these are unnecessary (sharding constraints let
+XLA insert the collectives); the explicit forms exist for shard_map-style
+Megatron-exact programs and for the pipeline/ring paths.
+
+Megatron's backward convention (tensors downstream of a gather are *replicated*
+across the TP group, so the adjoint of gather is a plain slice, not a
+reduce-scatter) is preserved exactly:
+
+| fn                | forward             | backward            | ref            |
+|-------------------|---------------------|---------------------|----------------|
+| copy_to_...       | identity            | psum                | mappings.py:23 |
+| reduce_from_...   | psum                | identity            | mappings.py:36 |
+| scatter_to_...    | slice (last dim)    | all-gather          | mappings.py:49 |
+| gather_from_...   | all-gather (last)   | slice (last dim)    | mappings.py:62 |
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from apex_tpu.parallel.mesh import AXIS_MODEL
+
+
+def _local_slice(x, axis_name: str, dim: int = -1):
+    """This rank's chunk of ``x`` along ``dim`` (mappings.py _split, :75-87)."""
+    n = lax.axis_size(axis_name)
+    dim = dim % x.ndim
+    size = x.shape[dim] // n
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis: str = AXIS_MODEL):
+    """Identity forward, all-reduce backward (_CopyToModelParallelRegion,
+    mappings.py:23-33). Applied to the input of a column-parallel linear."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis: str = AXIS_MODEL):
+    """All-reduce forward, identity backward (_ReduceFromModelParallelRegion,
+    mappings.py:36-46). Applied to the output of a row-parallel linear."""
+    return lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis: str = AXIS_MODEL):
+    """Slice this rank's last-dim chunk forward, all-gather backward
+    (_ScatterToModelParallelRegion, mappings.py:49-59)."""
+    return _local_slice(x, axis)
+
+
+def _scatter_fwd(x, axis):
+    return _local_slice(x, axis), None
+
+
+def _scatter_bwd(axis, _, g):
+    return (lax.all_gather(g, axis, axis=g.ndim - 1, tiled=True),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis: str = AXIS_MODEL):
+    """All-gather on the last dim forward, slice backward
+    (_GatherFromModelParallelRegion, mappings.py:62-72). The sliced backward
+    encodes Megatron's replicated-downstream convention — see module doc."""
+    return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+
+def _gather_fwd(x, axis):
+    return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True), None
+
+
+def _gather_bwd(axis, _, g):
+    return (_local_slice(g, axis),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)

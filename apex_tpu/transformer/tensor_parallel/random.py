@@ -1,0 +1,83 @@
+"""Parallel RNG + activation checkpointing (reference: tensor_parallel/random.py).
+
+The reference maintains a ``CudaRNGStatesTracker`` juggling CUDA RNG state
+blobs so that dropout inside TP regions draws *different* randomness per TP
+rank while replicated regions draw the *same* (random.py:113-220, seeds at
+``:174-191``: data-parallel seed = base, model-parallel seed = base + 2718 +
+tp_rank). With JAX's key-based PRNG that entire machinery collapses to key
+folding — reproducibility is a property of the key, not hidden device state.
+
+Activation checkpointing (``CheckpointFunction`` + RNG save/restore,
+random.py:224-294) maps to ``jax.checkpoint``: recompute-in-backward with
+*identical* randomness is automatic because the same key is an argument.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.parallel.mesh import AXIS_MODEL
+
+# The reference's model-parallel seed offset (random.py:182: 2718).
+_MODEL_PARALLEL_OFFSET = 2718
+
+
+def model_parallel_key(key: jax.Array, axis: str = AXIS_MODEL) -> jax.Array:
+    """A key that differs per TP rank (the tracker's "model-parallel-rng",
+    random.py:174-191). Valid inside shard_map binding ``axis``."""
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _MODEL_PARALLEL_OFFSET), lax.axis_index(axis)
+    )
+
+
+def data_parallel_key(key: jax.Array) -> jax.Array:
+    """A key identical across TP ranks (the default CUDA state in the
+    reference). Identity — named for call-site symmetry."""
+    return key
+
+
+class RNGStatesTracker:
+    """API-parity shim for ``get_cuda_rng_tracker().fork()`` call sites.
+
+    Functional JAX passes keys explicitly; this object just dispenses them:
+    ``tracker.key("model-parallel-rng")`` returns the folded key. It exists so
+    migrated Megatron-style model code keeps its shape.
+    """
+
+    MODEL_PARALLEL = "model-parallel-rng"
+
+    def __init__(self, base_key: jax.Array, axis: Optional[str] = AXIS_MODEL):
+        self._base = base_key
+        self._axis = axis
+
+    def key(self, name: str = MODEL_PARALLEL) -> jax.Array:
+        if name == self.MODEL_PARALLEL:
+            if self._axis is not None:
+                return model_parallel_key(self._base, self._axis)
+            return jax.random.fold_in(self._base, _MODEL_PARALLEL_OFFSET)
+        return self._base
+
+
+def checkpoint(
+    fn: Callable,
+    *,
+    policy: Optional[Callable] = None,
+    prevent_cse: bool = True,
+) -> Callable:
+    """Activation checkpointing (reference CheckpointFunction, random.py:224-294).
+
+    ``jax.checkpoint`` recomputes ``fn`` during backward instead of saving
+    activations; RNG save/restore (random.py:248-262) is unnecessary because
+    randomness comes from explicit key arguments. The reference's
+    "checkpoint selective recompute" knob maps to ``policy`` (e.g.
+    ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable`` keeps GEMM
+    outputs — the flash-attention-friendly policy).
+    """
+    return jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)
+
+
+# Common policies re-exported under task-oriented names.
+checkpoint_policies = jax.checkpoint_policies

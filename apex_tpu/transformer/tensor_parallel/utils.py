@@ -1,0 +1,48 @@
+"""Shape/vocab utilities (reference: apex/transformer/tensor_parallel/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """utils.py `divide` equivalent: exact integer division with a check."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x: jnp.ndarray, num_partitions: int) -> Sequence[jnp.ndarray]:
+    """Split the last dim into equal chunks (utils.py:split_tensor_along_last_dim).
+
+    JAX arrays are immutable so the reference's ``contiguous_split_chunks``
+    knob is moot — every split is a fresh (lazily materialized) array.
+    """
+    last = x.shape[-1]
+    divide(last, num_partitions)
+    return jnp.split(x, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Vocab range arithmetic for vocab-parallel embeddings
+    (reference: utils.py VocabUtility)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank: int
+    ) -> Tuple[int, int]:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank: int, world_size: int
+    ) -> Tuple[int, int]:
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(per, rank)
