@@ -1,0 +1,482 @@
+"""Flash attention — blockwise fused attention as a Pallas TPU kernel.
+
+This one kernel family subsumes three of the reference's CUDA extensions
+(SURVEY.md §2.2): ``fmhalib`` (flash-style fused MHA, fp16 seq ≤ 512, SM80 —
+apex/contrib/fmha/fmha.py:33-74), ``fast_multihead_attn`` (fused self/encdec
+attention, apex/contrib/multihead_attn/), and the two Megatron fused-softmax
+kernels (csrc/megatron/scaled_(upper_triang_)masked_softmax.h, sk ≤ 2048)
+whose job was to keep the score matrix out of HBM. Blockwise online softmax
+(the published FlashAttention recurrence) never materializes scores at all,
+and has no 512/2048 sequence cap — the envelope is VMEM, and beyond that the
+``context``-axis ring attention (apex_tpu.transformer.ring) tiles over chips.
+
+Layout: ``(batch, heads, seq, head_dim)`` — the reference's score layout
+``(b, np, sq, sk)`` (fused_softmax.py:67-92) with head_dim restored.
+
+Forward saves only O and the per-row logsumexp; backward recomputes scores
+blockwise (the fmha/FlashAttention memory plan) in two passes: one gridded
+over q-blocks for dQ, one over k-blocks for dK/dV.
+
+Masking: ``causal=True`` for the upper-triangular variant, and/or an additive
+``bias`` broadcastable to ``(b, h, sq, sk)`` (the additive-mask path of
+fast_multihead_attn; boolean masks become ``-10000`` biases upstream, matching
+the reference's masked_fill value).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops.layer_norm import _interpret, _resolve_impl
+
+_NEG_INF = -1e30
+
+
+def _pick_block(n: int, target: int) -> int:
+    """Largest multiple-of-8 divisor of n that is <= target (n if none)."""
+    best = None
+    for cand in range(min(n, target), 7, -1):
+        if n % cand == 0 and cand % 8 == 0:
+            best = cand
+            break
+    return best if best is not None else n
+
+
+def _supported(sq: int, sk: int, d: int) -> bool:
+    """Shapes the Pallas path handles without padding: 8-aligned seqs.
+
+    The analog of the reference's ``is_kernel_available`` envelope
+    (fused_softmax.py:151-171) — unsupported shapes fall back to the XLA
+    path, like the reference falls back to torch softmax."""
+    return sq % 8 == 0 and sk % 8 == 0 and d >= 8
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *, scale, causal, blk_q, blk_k):
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
+    sk = k_ref.shape[2]
+    d = q.shape[-1]
+    qi = pl.program_id(2)
+    nk = sk // blk_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (blk_q, blk_k)
+        if b_ref is not None:
+            s = s + b_ref[0, 0, :, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((blk_q, d), jnp.float32)
+    m0 = jnp.full((blk_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    if causal:
+        # skip k-blocks strictly above the diagonal (fully masked): the
+        # triangular-work saving the reference's upper-triang kernel gets
+        # from its tiling (scaled_upper_triang_masked_softmax.h).
+        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * blk_q, blk_k))
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m0, l0))
+    # Fully-masked rows (possible with an all -inf bias row) have l == 0.
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
+
+
+# ---------------------------------------------------------------------------
+# Backward: dQ pass (grid over q-blocks), then dK/dV pass (grid over k-blocks)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref, dq_ref, db_ref,
+    *, scale, causal, blk_q, blk_k, b_bcast, h_bcast,
+):
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    sk = k_ref.shape[2]
+    qi = pl.program_id(2)
+    nk = sk // blk_k
+
+    if db_ref is not None:
+        # A bias broadcast over batch/heads maps several grid steps onto the
+        # same dbias block: zero it on the first visit, accumulate after
+        # (TPU grid iteration is sequential, so read-modify-write is safe).
+        conds = []
+        if b_bcast:
+            conds.append(pl.program_id(0) == 0)
+        if h_bcast:
+            conds.append(pl.program_id(1) == 0)
+        if conds:
+            pred = conds[0]
+            for c in conds[1:]:
+                pred = pred & c
+
+            @pl.when(pred)
+            def _zero():
+                db_ref[0, 0] = jnp.zeros_like(db_ref[0, 0])
+
+        else:
+            db_ref[0, 0] = jnp.zeros_like(db_ref[0, 0])
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if b_ref is not None:
+            s = s + b_ref[0, 0, :, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        if db_ref is not None:
+            cur = db_ref[0, 0, :, pl.ds(j * blk_k, blk_k)]
+            db_ref[0, 0, :, pl.ds(j * blk_k, blk_k)] = cur + ds
+        return dq + scale * jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * blk_q, blk_k))
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros_like(q))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal, blk_q, blk_k,
+):
+    k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    sq = q_ref.shape[2]
+    ki = pl.program_id(2)
+    nq = sq // blk_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * blk_q, blk_q), :]
+        delta = delta_ref[0, 0, pl.ds(i * blk_q, blk_q), :]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (blk_q, blk_k)
+        if b_ref is not None:
+            s = s + b_ref[0, 0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
+        if causal:
+            q_pos = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        p = jnp.exp(s - lse)  # (blk_q, blk_k)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_new = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    # Under causal masking, q-blocks entirely left of this k-block's diagonal
+    # contribute nothing — start at the first intersecting block.
+    start = (ki * blk_k) // blk_q if causal else 0
+    dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+
+def _bias_specs(bias, b, h, blk_q, sq, sk, full_q=False):
+    """BlockSpec for an additive bias of shape (b|1, h|1, sq, sk).
+
+    Size-1 batch/head dims are handled by pinning the index map to 0; size-1
+    sq/sk dims are canonicalized away by ``flash_attention`` (broadcast_to)
+    before the custom_vjp boundary, so they never reach here.
+    """
+    if bias is None:
+        return None, None
+    bb, bh = bias.shape[0], bias.shape[1]
+
+    def idx(bi, hi, qi):
+        return (bi if bb > 1 else 0, hi if bh > 1 else 0, 0 if full_q else qi, 0)
+
+    blk = (1, 1, sq if full_q else blk_q, sk)
+    return bias, pl.BlockSpec(blk, idx, memory_space=pltpu.VMEM)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "causal", "blk_q", "blk_k")
+)
+def _flash_fwd(q, k, v, bias, *, scale, causal, blk_q, blk_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    grid = (b, h, sq // blk_q)
+    qspec = pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM)
+    ospec = qspec
+    lspec = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM)
+    bias_arr, bspec = _bias_specs(bias, b, h, blk_q, sq, sk)
+    in_specs = [qspec, kspec, kspec] + ([bspec] if bias is not None else [])
+    args = (q, k, v) + ((bias_arr,) if bias is not None else ())
+
+    kern = functools.partial(
+        _fwd_kernel if bias is not None else
+        (lambda qr, kr, vr, orf, lr, **kw: _fwd_kernel(qr, kr, vr, None, orf, lr, **kw)),
+        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+    )
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[ospec, lspec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return o, lse
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "causal", "blk_q", "blk_k")
+)
+def _flash_bwd(q, k, v, bias, o, lse, do, *, scale, causal, blk_q, blk_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # (b, h, sq, 1)
+
+    qspec = pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0),
+                         memory_space=pltpu.VMEM)
+    kfull = pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM)
+    lblk = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0),
+                        memory_space=pltpu.VMEM)
+    bias_arr, bspec = _bias_specs(bias, b, h, blk_q, sq, sk)
+
+    # dQ pass: grid over q blocks; also emits dS accumulated into dbias.
+    in_specs = [qspec, kfull, kfull]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(bspec)
+        args.append(bias_arr)
+    in_specs += [qspec, lblk, lblk]
+    args += [do, lse, delta]
+
+    b_bcast = bias is not None and bias.shape[0] == 1
+    h_bcast = bias is not None and bias.shape[1] == 1
+
+    def dq_kern(*refs):
+        if bias is not None:
+            qr, kr, vr, br, dor, lr, dr, dqr, dbr = refs
+        else:
+            qr, kr, vr, dor, lr, dr, dqr = refs
+            br = dbr = None
+        _bwd_dq_kernel(qr, kr, vr, br, dor, lr, dr, dqr, dbr,
+                       scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+                       b_bcast=b_bcast, h_bcast=h_bcast)
+
+    out_specs = [qspec]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if bias is not None:
+        bb, bh = bias.shape[0], bias.shape[1]
+        out_specs.append(pl.BlockSpec(
+            (1, 1, blk_q, sk),
+            lambda bi, hi, qi: (bi if bb > 1 else 0, hi if bh > 1 else 0, qi, 0),
+            memory_space=pltpu.VMEM,
+        ))
+        out_shape.append(jax.ShapeDtypeStruct(bias.shape, jnp.float32))
+    res = pl.pallas_call(
+        dq_kern,
+        grid=(b, h, sq // blk_q),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(*args)
+    dq, dbias = (res[0], res[1]) if bias is not None else (res[0], None)
+
+    # dK/dV pass: grid over k blocks; q/do/lse/delta stream in full.
+    qfull = pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM)
+    kblk = pl.BlockSpec((1, 1, blk_k, d), lambda bi, hi, ki: (bi, hi, ki, 0),
+                        memory_space=pltpu.VMEM)
+    lfull = pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0),
+                         memory_space=pltpu.VMEM)
+    in_specs2 = [qfull, kblk, kblk]
+    args2 = [q, k, v]
+    if bias is not None:
+        bb, bh = bias.shape[0], bias.shape[1]
+        bspec2 = pl.BlockSpec(
+            (1, 1, sq, blk_k),
+            lambda bi, hi, ki: (bi if bb > 1 else 0, hi if bh > 1 else 0, 0, ki),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs2.append(bspec2)
+        args2.append(bias)
+    in_specs2 += [qfull, lfull, lfull]
+    args2 += [do, lse, delta]
+
+    def dkv_kern(*refs):
+        if bias is not None:
+            qr, kr, vr, br, dor, lr, dr, dkr, dvr = refs
+        else:
+            qr, kr, vr, dor, lr, dr, dkr, dvr = refs
+            br = None
+        _bwd_dkv_kernel(qr, kr, vr, br, dor, lr, dr, dkr, dvr,
+                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(b, h, sk // blk_k),
+        in_specs=in_specs2,
+        out_specs=[kblk, kblk],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(*args2)
+    return dq, dk, dv, dbias
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp + public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, scale, causal, blk_q, blk_k):
+    o, _ = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+                      blk_q=blk_q, blk_k=blk_k)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, bias, scale, causal, blk_q, blk_k):
+    o, lse = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+                        blk_q=blk_q, blk_k=blk_k)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, blk_q, blk_k, res, do):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, do, scale=scale,
+                                   causal=causal, blk_q=blk_q, blk_k=blk_k)
+    if dbias is not None:
+        dbias = dbias.astype(bias.dtype)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def mha_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    bias: Optional[jax.Array] = None,
+    *, causal: bool = False, scale: Optional[float] = None,
+) -> jax.Array:
+    """Unfused XLA attention (the torch-softmax fallback path,
+    fused_softmax.py:193-199 forward_torch_softmax equivalent)."""
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_pos = jnp.arange(sq)[:, None]
+        k_pos = jnp.arange(sk)[None, :]
+        s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    bias: Optional[jax.Array] = None,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    impl: str = "auto",
+) -> jax.Array:
+    """Fused multi-head attention.
+
+    Args:
+      q, k, v: ``(batch, heads, seq, head_dim)``; kv seq may differ from q seq
+        (encoder-decoder attention, apex/contrib/multihead_attn encdec path).
+      bias: optional additive bias broadcastable to ``(b, h, sq, sk)``
+        (additive-mask attention; use -10000 for masked positions like the
+        reference's masked_fill).
+      causal: upper-triangular masking (scaled_upper_triang_masked_softmax).
+      scale: score scale; defaults to 1/sqrt(head_dim).
+      impl: 'auto' | 'pallas' | 'xla'.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = (d ** -0.5) if scale is None else float(scale)
+    use = _resolve_impl(impl)
+    if use == "pallas" and not _supported(sq, sk, d):
+        use = "xla"
+    if use == "xla":
+        return mha_reference(q, k, v, bias, causal=causal, scale=scale)
+    blk_q = _pick_block(sq, block_q)
+    blk_k = _pick_block(sk, block_k)
+    if bias is not None:
+        if bias.ndim != 4:
+            raise ValueError(f"bias must be rank-4 broadcastable, got shape {bias.shape}")
+        # Canonicalize size-1 sq/sk dims away (the kernels tile dims 2/3 at
+        # full size). This sits outside the custom_vjp, so AD of broadcast_to
+        # sums dbias back to the caller's original shape.
+        bb, bh = bias.shape[0], bias.shape[1]
+        if bb not in (1, b) or bh not in (1, h):
+            raise ValueError(f"bias shape {bias.shape} not broadcastable to "
+                             f"({b}, {h}, {sq}, {sk})")
+        bias = jnp.broadcast_to(bias, (bb, bh, sq, sk))
+    return _flash(q, k, v, bias, scale, bool(causal), blk_q, blk_k)

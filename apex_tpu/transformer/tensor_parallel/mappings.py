@@ -31,9 +31,11 @@ from apex_tpu.parallel.mesh import AXIS_MODEL
 
 def _local_slice(x, axis_name: str, dim: int = -1):
     """This rank's chunk of ``x`` along ``dim`` (mappings.py _split, :75-87)."""
+    from apex_tpu.transformer.tensor_parallel.utils import divide
+
     n = lax.axis_size(axis_name)
     dim = dim % x.ndim
-    size = x.shape[dim] // n
+    size = divide(x.shape[dim], n)  # the reference's divisibility guard
     idx = lax.axis_index(axis_name)
     return lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
 

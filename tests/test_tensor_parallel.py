@@ -220,6 +220,17 @@ def test_model_parallel_key_differs_per_rank(tp_mesh):
     assert len(np.unique(vals)) == TP  # distinct randomness per TP rank
 
 
+def test_scatter_indivisible_raises(tp_mesh):
+    x = jnp.ones((4, 10))  # 10 not divisible by TP=4
+
+    def body(x):
+        return tp.scatter_to_tensor_model_parallel_region(x, "model")
+
+    fn = _shard_map(tp_mesh, body, in_specs=P(), out_specs=P(None, "model"))
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.jit(fn)(x)
+
+
 def test_vocab_utility():
     assert tp.VocabUtility.vocab_range_from_global_vocab_size(64, 1, 4) == (16, 32)
     with pytest.raises(ValueError):
