@@ -1,0 +1,73 @@
+"""Fused dense layers (reference: apex/fused_dense/fused_dense.py:6-85 +
+csrc/fused_dense_cuda.cu cublasLt epilogues).
+
+``FusedDense`` = GEMM + bias; ``FusedDenseGeluDense`` = GEMM + bias + GeLU +
+GEMM + bias, the cublasLt epilogue-fusion chain. On TPU, XLA fuses these
+epilogues into the MXU matmuls when they appear in one jitted function, so
+the module is the API shape, the compiler is the kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jax.Array]
+
+
+def _linear_init(key, n_in, n_out, dtype):
+    bound = 1.0 / (n_in ** 0.5)
+    return {
+        "kernel": jax.random.uniform(key, (n_in, n_out), dtype, -bound, bound),
+        "bias": jax.random.uniform(
+            jax.random.fold_in(key, 1), (n_out,), dtype, -bound, bound
+        ),
+    }
+
+
+@dataclasses.dataclass
+class FusedDense:
+    """GEMM + bias (FusedDense, fused_dense.py:6-35)."""
+
+    in_features: int
+    out_features: int
+    params_dtype: Any = jnp.float32
+
+    def init(self, key: jax.Array) -> Params:
+        return _linear_init(key, self.in_features, self.out_features, self.params_dtype)
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        return x @ params["kernel"].astype(x.dtype) + params["bias"].astype(x.dtype)
+
+    __call__ = apply
+
+
+@dataclasses.dataclass
+class FusedDenseGeluDense:
+    """GEMM+bias+GeLU+GEMM+bias (FusedDenseGeluDense, fused_dense.py:38-85)."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    params_dtype: Any = jnp.float32
+
+    def init(self, key: jax.Array) -> Params:
+        k1, k2 = jax.random.split(key)
+        return {
+            "dense1": _linear_init(k1, self.in_features, self.intermediate_features,
+                                   self.params_dtype),
+            "dense2": _linear_init(k2, self.intermediate_features, self.out_features,
+                                   self.params_dtype),
+        }
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        h = x @ params["dense1"]["kernel"].astype(x.dtype)
+        h = jax.nn.gelu(h + params["dense1"]["bias"].astype(x.dtype))
+        return h @ params["dense2"]["kernel"].astype(x.dtype) + params["dense2"][
+            "bias"
+        ].astype(x.dtype)
+
+    __call__ = apply

@@ -1,0 +1,270 @@
+"""Megatron-style GPT (reference: apex/transformer/testing/standalone_gpt.py:236-1517).
+
+The reference vendors a full Megatron GPT (ParallelMLP, ParallelAttention,
+ParallelTransformer, Embedding, GPTModel) as the test/benchmark vehicle for
+its transformer framework. This is the TPU-native counterpart, built from
+apex_tpu.transformer.tensor_parallel layers:
+
+- token embedding: ``VocabParallelEmbedding`` (+ learned positions);
+- per layer: LN → fused-QKV ``ColumnParallelLinear`` (no gather; output laid
+  out ``(heads, 3, head_dim)`` so a TP shard holds whole heads, the layout
+  contract of ParallelAttention, standalone_gpt.py:560-640) → flash attention
+  on local heads → ``RowParallelLinear`` projection → residual → LN →
+  column/row MLP with GeLU → residual;
+- final LN → tied vocab-parallel LM head → ``vocab_parallel_cross_entropy``.
+
+TPU-first structural choices (vs the reference's per-layer nn.ModuleList):
+
+- layer parameters are **stacked** on a leading ``(num_layers, ...)`` dim and
+  the stack is driven by ``lax.scan`` — one traced layer body regardless of
+  depth (compile time O(1) in layers), and the natural shape for pipeline
+  stages to slice;
+- activation checkpointing is ``jax.checkpoint`` on the scanned body
+  (reference: tensor_parallel/random.py:224-294 CheckpointFunction);
+- dropout randomness comes from an explicit key, split per layer and folded
+  per TP rank where state must differ (random.py:174-191 semantics).
+
+Serial (``axis=None``) and shard_map-parallel execution use the same params
+and the same code path, like the rest of the framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.layer_norm import layer_norm as fused_layer_norm_op
+from apex_tpu.parallel.mesh import AXIS_MODEL
+from apex_tpu.transformer import tensor_parallel as tp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Model hyperparameters (reference: testing/arguments.py essentials)."""
+
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_attention_heads: int = 16
+    max_seq_len: int = 1024
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    axis: Optional[str] = AXIS_MODEL  # tensor-parallel mesh axis (None=serial)
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    hidden_dropout: float = 0.1
+    init_method_std: float = 0.02
+    remat: bool = True  # activation checkpointing per layer
+    attention_impl: str = "auto"  # flash_attention impl switch
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+class GPTModel:
+    """Functional GPT with TP-sharded params (GPTModel, standalone_gpt.py:1361+).
+
+    ``init(key)`` → full param tree; ``specs()`` → PartitionSpec tree;
+    ``apply(params, tokens, targets=..., dropout_key=...)`` → per-token loss
+    (or logits when ``targets`` is None). ``embed`` / ``run_layers`` /
+    ``head`` expose the stage boundaries pipeline schedules need (the
+    functional replacement for the reference's pre_process/post_process
+    flags and set_input_tensor, pipeline_parallel/schedules/common.py:24-112).
+    """
+
+    def __init__(self, config: GPTConfig):
+        self.cfg = config
+        c = config
+        if c.hidden_size % c.num_attention_heads:
+            raise ValueError("hidden_size must divide evenly into heads")
+        init = tp.scaled_normal(c.init_method_std)
+        # Megatron scales output-layer init by 1/sqrt(2L)
+        # (standalone_gpt.py scaled_init_method_normal).
+        out_init = tp.scaled_normal(c.init_method_std / (2 * c.num_layers) ** 0.5)
+        self.embedding = tp.VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, axis=c.axis,
+            params_dtype=c.params_dtype, init_method=init,
+        )
+        self.qkv = tp.ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, axis=c.axis, gather_output=False,
+            params_dtype=c.params_dtype, init_method=init,
+        )
+        self.proj = tp.RowParallelLinear(
+            c.hidden_size, c.hidden_size, axis=c.axis, input_is_parallel=True,
+            params_dtype=c.params_dtype, init_method=out_init,
+        )
+        self.fc1 = tp.ColumnParallelLinear(
+            c.hidden_size, c.ffn, axis=c.axis, gather_output=False,
+            params_dtype=c.params_dtype, init_method=init,
+        )
+        self.fc2 = tp.RowParallelLinear(
+            c.ffn, c.hidden_size, axis=c.axis, input_is_parallel=True,
+            params_dtype=c.params_dtype, init_method=out_init,
+        )
+
+    # -- parameters ---------------------------------------------------------
+
+    def _ln_init(self) -> Params:
+        c = self.cfg
+        return {
+            "scale": jnp.ones((c.hidden_size,), c.params_dtype),
+            "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+        }
+
+    def init(self, key: jax.Array) -> Params:
+        c = self.cfg
+        keys = jax.random.split(key, 4)
+        pos = tp.scaled_normal(c.init_method_std)(
+            keys[1], (c.max_seq_len, c.hidden_size), c.params_dtype
+        )
+
+        def layer_params(k) -> Params:
+            ks = jax.random.split(k, 4)
+            return {
+                "ln1": self._ln_init(),
+                "qkv": self.qkv.init(ks[0]),
+                "proj": self.proj.init(ks[1]),
+                "ln2": self._ln_init(),
+                "fc1": self.fc1.init(ks[2]),
+                "fc2": self.fc2.init(ks[3]),
+            }
+
+        layer_keys = jax.random.split(keys[2], c.num_layers)
+        # Stack per-layer trees along a leading num_layers dim (vmap over
+        # init is the cleanest way to build the scan-shaped stack).
+        layers = jax.vmap(layer_params)(layer_keys)
+        return {
+            "embedding": self.embedding.init(keys[0]),
+            "position": pos,
+            "layers": layers,
+            "ln_f": self._ln_init(),
+        }
+
+    def specs(self) -> Params:
+        ln = {"scale": P(), "bias": P()}
+
+        def stack(spec_tree):
+            return jax.tree.map(
+                lambda s: P(None, *s), spec_tree,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        return {
+            "embedding": self.embedding.specs(),
+            "position": P(),
+            "layers": {
+                "ln1": stack(ln),
+                "qkv": stack(self.qkv.specs()),
+                "proj": stack(self.proj.specs()),
+                "ln2": stack(ln),
+                "fc1": stack(self.fc1.specs()),
+                "fc2": stack(self.fc2.specs()),
+            },
+            "ln_f": ln,
+        }
+
+    # -- stages -------------------------------------------------------------
+
+    def _ln(self, p: Params, x: jax.Array) -> jax.Array:
+        # Mixed-dtype fused LN: bf16 activations, fp32 γβ
+        # (MixedFusedLayerNorm, fused_layer_norm.py:398-436).
+        return fused_layer_norm_op(x, p["scale"], p["bias"])
+
+    def embed(self, params: Params, tokens: jax.Array) -> jax.Array:
+        c = self.cfg
+        h = self.embedding.apply(params["embedding"], tokens)
+        pos = params["position"][: tokens.shape[-1]]
+        return (h + pos).astype(c.compute_dtype)
+
+    def _attention(self, p: Params, h: jax.Array) -> jax.Array:
+        c = self.cfg
+        b, s, _ = h.shape
+        qkv = self.qkv.apply(p["qkv"], h)  # (b, s, 3*H/tp)
+        n_local = qkv.shape[-1] // (3 * c.head_dim)
+        qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
+        attn = flash_attention(q, k, v, causal=True, impl=c.attention_impl)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
+        return self.proj.apply(p["proj"], attn)
+
+    def _mlp(self, p: Params, h: jax.Array) -> jax.Array:
+        return self.fc2.apply(p["fc2"], jax.nn.gelu(self.fc1.apply(p["fc1"], h)))
+
+    def _dropout(self, x, key, rank_unique: bool):
+        c = self.cfg
+        if key is None or c.hidden_dropout == 0.0:
+            return x
+        if rank_unique and c.axis is not None:
+            key = tp.model_parallel_key(key, c.axis)
+        keep = 1.0 - c.hidden_dropout
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def _layer(self, p: Params, h: jax.Array, key) -> jax.Array:
+        k1, k2 = (None, None) if key is None else tuple(jax.random.split(key))
+        # Post-residual dropout is replicated across TP ranks (same key);
+        # the reference draws it from the default (data-parallel) RNG state.
+        h = h + self._dropout(self._attention(p, self._ln(p["ln1"], h)), k1, False)
+        h = h + self._dropout(self._mlp(p, self._ln(p["ln2"], h)), k2, False)
+        return h
+
+    def run_layers(
+        self, layers: Params, h: jax.Array, dropout_key: Optional[jax.Array] = None
+    ) -> jax.Array:
+        """Scan the (stacked) layer params over the hidden state. ``layers``
+        may be any contiguous slice of the stack — a pipeline stage's chunk."""
+        n = jax.tree.leaves(layers)[0].shape[0]
+        keys = None if dropout_key is None else jax.random.split(dropout_key, n)
+
+        def body(h, xs):
+            p, k = xs
+            return self._layer(p, h, k), None
+
+        if self.cfg.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        h, _ = lax.scan(body, h, (layers, keys))
+        return h
+
+    def head(
+        self, params: Params, h: jax.Array,
+        targets: Optional[jax.Array] = None,
+    ):
+        """Final LN + tied LM head (+ per-token loss when targets given)
+        (post_language_model_processing, standalone_gpt.py:1361+)."""
+        c = self.cfg
+        h = self._ln(params["ln_f"], h)
+        wte = params["embedding"]["embedding"].astype(h.dtype)  # (V/tp, H)
+        if c.axis is not None:
+            h = tp.copy_to_tensor_model_parallel_region(h, c.axis)
+        logits = jnp.einsum("bsh,vh->bsv", h, wte)  # vocab-sharded logits
+        if targets is None:
+            return logits
+        return tp.vocab_parallel_cross_entropy(logits, targets, axis=c.axis)
+
+    def apply(
+        self,
+        params: Params,
+        tokens: jax.Array,
+        targets: Optional[jax.Array] = None,
+        dropout_key: Optional[jax.Array] = None,
+    ):
+        h = self.embed(params, tokens)
+        h = self.run_layers(params["layers"], h, dropout_key)
+        return self.head(params, h, targets)
+
+    def loss(self, params, tokens, targets, dropout_key=None) -> jax.Array:
+        """Mean per-token loss — the fwd_step_func contract
+        (schedules/common.py:196-255 loss reduction)."""
+        return jnp.mean(self.apply(params, tokens, targets, dropout_key))

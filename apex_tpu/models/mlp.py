@@ -1,0 +1,78 @@
+"""Fused MLP (reference: apex/mlp/mlp.py:8-79 + csrc/mlp_cuda.cu).
+
+The reference runs an entire multi-layer perceptron (chained GEMMs + fused
+bias/activation epilogues) in one extension call to amortize launch overhead
+and keep intermediates out of global memory. Under XLA the same chain,
+expressed as one jitted function, compiles to exactly that — GEMMs with fused
+bias/activation epilogues on the MXU — so the TPU-native MLP is the
+composition itself; no custom kernel can beat what the compiler already does
+here (SURVEY.md §7 step 3: "benchmark first; keep the API, let impl be lax
+if XLA wins").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+@dataclasses.dataclass
+class MLP:
+    """Drop-in MLP module (apex/mlp/mlp.py:44-79).
+
+    ``mlp_sizes`` lists layer widths including input, e.g. (480, 1024, 960).
+    ``activation`` ∈ {'none', 'relu', 'sigmoid'} applies between layers and
+    after the last (matching the reference kernel's epilogue placement).
+    """
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    params_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if len(self.mlp_sizes) < 2:
+            raise ValueError("need at least input and one layer size")
+        if self.activation not in _ACTIVATIONS:
+            raise ValueError(f"unknown activation {self.activation!r}")
+
+    def init(self, key: jax.Array) -> List[Dict[str, jax.Array]]:
+        layers = []
+        for i, (n_in, n_out) in enumerate(zip(self.mlp_sizes[:-1], self.mlp_sizes[1:])):
+            k = jax.random.fold_in(key, i)
+            # Reference resets weights uniform(-1/sqrt(fan_in), +) like
+            # nn.Linear (mlp.py:66-73).
+            bound = 1.0 / (n_in ** 0.5)
+            p = {
+                "kernel": jax.random.uniform(
+                    k, (n_in, n_out), self.params_dtype, -bound, bound
+                )
+            }
+            if self.bias:
+                p["bias"] = jax.random.uniform(
+                    jax.random.fold_in(k, 1), (n_out,), self.params_dtype, -bound, bound
+                )
+            layers.append(p)
+        return layers
+
+    def apply(self, params: List[Dict[str, jax.Array]], x: jax.Array) -> jax.Array:
+        act = _ACTIVATIONS[self.activation]
+        for p in params:
+            x = x @ p["kernel"].astype(x.dtype)
+            if "bias" in p:
+                x = x + p["bias"].astype(x.dtype)
+            x = act(x)
+        return x
+
+    def __call__(self, params, x):
+        return self.apply(params, x)

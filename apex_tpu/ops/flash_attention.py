@@ -113,25 +113,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *, scale, causal, bl
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref, dq_ref, db_ref,
-    *, scale, causal, blk_q, blk_k, b_bcast, h_bcast,
+    *, scale, causal, blk_q, blk_k, b_bcast, h_bcast, dims,
 ):
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
     sk = k_ref.shape[2]
-    qi = pl.program_id(2)
+    # dims maps logical (b, h, q) grid coordinates to program_id positions —
+    # _flash_bwd orders the grid so dbias revisits are *consecutive*.
+    qi = pl.program_id(dims["q"])
     nk = sk // blk_k
 
     if db_ref is not None:
         # A bias broadcast over batch/heads maps several grid steps onto the
-        # same dbias block: zero it on the first visit, accumulate after
-        # (TPU grid iteration is sequential, so read-modify-write is safe).
+        # same dbias block. Pallas only keeps an output window live across
+        # consecutive same-index steps, so the broadcast dims iterate
+        # innermost (see _dq_grid_order); zero on the first visit, then
+        # accumulate.
         conds = []
         if b_bcast:
-            conds.append(pl.program_id(0) == 0)
+            conds.append(pl.program_id(dims["b"]) == 0)
         if h_bcast:
-            conds.append(pl.program_id(1) == 0)
+            conds.append(pl.program_id(dims["h"]) == 0)
         if conds:
             pred = conds[0]
             for c in conds[1:]:
@@ -225,22 +229,31 @@ def _bwd_dkv_kernel(
 # ---------------------------------------------------------------------------
 
 
-def _bias_specs(bias, b, h, blk_q, sq, sk, full_q=False):
-    """BlockSpec for an additive bias of shape (b|1, h|1, sq, sk).
-
-    Size-1 batch/head dims are handled by pinning the index map to 0; size-1
+def _bias_spec(bias, blk_q, sk):
+    """BlockSpec for an additive bias of shape (b|1, h|1, sq, sk), for grids
+    ordered (b, h, q). Size-1 batch/head dims pin the index map to 0; size-1
     sq/sk dims are canonicalized away by ``flash_attention`` (broadcast_to)
     before the custom_vjp boundary, so they never reach here.
     """
-    if bias is None:
-        return None, None
     bb, bh = bias.shape[0], bias.shape[1]
 
     def idx(bi, hi, qi):
-        return (bi if bb > 1 else 0, hi if bh > 1 else 0, 0 if full_q else qi, 0)
+        return (bi if bb > 1 else 0, hi if bh > 1 else 0, qi, 0)
 
-    blk = (1, 1, sq if full_q else blk_q, sk)
-    return bias, pl.BlockSpec(blk, idx, memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, 1, blk_q, sk), idx, memory_space=pltpu.VMEM)
+
+
+def _dq_grid_order(bias, b_bcast, h_bcast):
+    """Logical-(b, h, q) → grid-position order for the dQ pass.
+
+    dbias blocks are revisited across the broadcast dims, and Pallas output
+    windows persist only across *consecutive* same-index steps — so whichever
+    dims collapse in the dbias index map must iterate innermost."""
+    if bias is None:
+        return ("b", "h", "q")
+    if b_bcast and not h_bcast:
+        return ("q", "h", "b")
+    return ("q", "b", "h")  # h broadcast, or both, or neither
 
 
 @functools.partial(
@@ -257,9 +270,9 @@ def _flash_fwd(q, k, v, bias, *, scale, causal, blk_q, blk_k):
     ospec = qspec
     lspec = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM)
-    bias_arr, bspec = _bias_specs(bias, b, h, blk_q, sq, sk)
+    bspec = None if bias is None else _bias_spec(bias, blk_q, sk)
     in_specs = [qspec, kspec, kspec] + ([bspec] if bias is not None else [])
-    args = (q, k, v) + ((bias_arr,) if bias is not None else ())
+    args = (q, k, v) + ((bias,) if bias is not None else ())
 
     kern = functools.partial(
         _fwd_kernel if bias is not None else
@@ -289,25 +302,43 @@ def _flash_bwd(q, k, v, bias, o, lse, do, *, scale, causal, blk_q, blk_k):
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
                     keepdims=True)  # (b, h, sq, 1)
 
-    qspec = pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0),
-                         memory_space=pltpu.VMEM)
-    kfull = pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
-                         memory_space=pltpu.VMEM)
-    lblk = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0),
-                        memory_space=pltpu.VMEM)
-    bias_arr, bspec = _bias_specs(bias, b, h, blk_q, sq, sk)
+    # dQ pass: grid over (b, h, q-blocks), reordered so dbias accumulation
+    # over broadcast dims happens on consecutive steps (see _dq_grid_order);
+    # also emits dS accumulated into dbias.
+    b_bcast = bias is not None and bias.shape[0] == 1
+    h_bcast = bias is not None and bias.shape[1] == 1
+    order = _dq_grid_order(bias, b_bcast, h_bcast)
+    dims = {name: pos for pos, name in enumerate(order)}
+    sizes = {"b": b, "h": h, "q": sq // blk_q}
+    grid = tuple(sizes[name] for name in order)
 
-    # dQ pass: grid over q blocks; also emits dS accumulated into dbias.
+    def reorder(fn):
+        """Wrap a logical (bi, hi, qi) index map for the reordered grid."""
+
+        def idx(*a):
+            return fn(a[dims["b"]], a[dims["h"]], a[dims["q"]])
+
+        return idx
+
+    qspec = pl.BlockSpec((1, 1, blk_q, d), reorder(lambda bi, hi, qi: (bi, hi, qi, 0)),
+                         memory_space=pltpu.VMEM)
+    kfull = pl.BlockSpec((1, 1, sk, d), reorder(lambda bi, hi, qi: (bi, hi, 0, 0)),
+                         memory_space=pltpu.VMEM)
+    lblk = pl.BlockSpec((1, 1, blk_q, 1), reorder(lambda bi, hi, qi: (bi, hi, qi, 0)),
+                        memory_space=pltpu.VMEM)
+
     in_specs = [qspec, kfull, kfull]
     args = [q, k, v]
     if bias is not None:
-        in_specs.append(bspec)
-        args.append(bias_arr)
+        bb, bh = bias.shape[0], bias.shape[1]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, blk_q, sk),
+            reorder(lambda bi, hi, qi: (bi if bb > 1 else 0, hi if bh > 1 else 0, qi, 0)),
+            memory_space=pltpu.VMEM,
+        ))
+        args.append(bias)
     in_specs += [qspec, lblk, lblk]
     args += [do, lse, delta]
-
-    b_bcast = bias is not None and bias.shape[0] == 1
-    h_bcast = bias is not None and bias.shape[1] == 1
 
     def dq_kern(*refs):
         if bias is not None:
@@ -317,21 +348,20 @@ def _flash_bwd(q, k, v, bias, o, lse, do, *, scale, causal, blk_q, blk_k):
             br = dbr = None
         _bwd_dq_kernel(qr, kr, vr, br, dor, lr, dr, dqr, dbr,
                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                       b_bcast=b_bcast, h_bcast=h_bcast)
+                       b_bcast=b_bcast, h_bcast=h_bcast, dims=dims)
 
     out_specs = [qspec]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     if bias is not None:
-        bb, bh = bias.shape[0], bias.shape[1]
         out_specs.append(pl.BlockSpec(
             (1, 1, blk_q, sk),
-            lambda bi, hi, qi: (bi if bb > 1 else 0, hi if bh > 1 else 0, qi, 0),
+            reorder(lambda bi, hi, qi: (bi if bb > 1 else 0, hi if bh > 1 else 0, qi, 0)),
             memory_space=pltpu.VMEM,
         ))
         out_shape.append(jax.ShapeDtypeStruct(bias.shape, jnp.float32))
     res = pl.pallas_call(
         dq_kern,
-        grid=(b, h, sq // blk_q),
+        grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,

@@ -165,13 +165,17 @@ def scaled_masked_softmax(
     mask: Optional[jax.Array] = None,
     scale: float = 1.0,
     *,
+    causal: bool = False,
     impl: str = "auto",
 ) -> jax.Array:
     """``softmax(scale*x masked to -10000)`` over sk
-    (ScaledMaskedSoftmax, fused_softmax.py:67-92)."""
+    (ScaledMaskedSoftmax, fused_softmax.py:67-92). ``causal=True`` composes
+    the upper-triangular mask with the boolean mask in one fused pass —
+    the decoder-with-padding case the reference's two separate kernels
+    cannot express together."""
     if _resolve_impl(impl) == "xla":
-        return _xla_softmax(x, mask, scale, causal=False).astype(x.dtype)
-    return _scaled_masked_softmax(x, mask, float(scale), False)
+        return _xla_softmax(x, mask, scale, causal=causal).astype(x.dtype)
+    return _scaled_masked_softmax(x, mask, float(scale), bool(causal))
 
 
 def scaled_upper_triang_masked_softmax(

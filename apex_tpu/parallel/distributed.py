@@ -1,0 +1,101 @@
+"""Data-parallel gradient reduction (reference: apex/parallel/distributed.py).
+
+The reference's ``DistributedDataParallel`` (:129-639) exists to overlap
+bucketed NCCL allreduces with backward compute: per-param grad hooks, bucket
+structure discovery in backward order, side streams, flatten/unflatten. Under
+XLA none of that machinery is needed — a ``psum`` over the ``data`` mesh axis
+inside the jitted step *is* the allreduce, and XLA's latency-hiding scheduler
+overlaps it with the backward automatically. What must be preserved are the
+**semantics** (SURVEY.md §2.3 row DP):
+
+- gradient *averaging* over the data-parallel group (:449-457);
+- ``allreduce_always_fp32``: upcast grads before the reduce (:52-58, buckets
+  split by dtype so fp16 grads can be reduced in fp32);
+- ``gradient_predivide_factor``: divide by a factor before the reduce and by
+  ``world/factor`` after, to keep fp16 sums in range (:167-175, 452-457).
+
+The ``Reducer`` manual variant (:89-126) maps to calling
+``allreduce_gradients`` yourself; ``delay_allreduce`` and bucket knobs are
+compile-time no-ops here and intentionally absent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_DATA
+
+AxisNames = Union[str, Tuple[str, ...]]
+
+
+def allreduce_gradients(
+    grads: Any,
+    axes: AxisNames = (AXIS_DATA, AXIS_CONTEXT),
+    *,
+    allreduce_always_fp32: bool = False,
+    gradient_average: bool = True,
+    gradient_predivide_factor: float = 1.0,
+) -> Any:
+    """Average a gradient pytree over the data-parallel mesh axes.
+
+    Call inside ``shard_map``/``pjit`` after ``value_and_grad`` — the moral
+    equivalent of apex DDP's bucketed hook pipeline collapsed to one traced
+    collective (allreduce_bucket, distributed.py:425-475).
+    """
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    world = 1
+    for a in axes:
+        world *= lax.axis_size(a)
+    pre = float(gradient_predivide_factor)
+
+    def _reduce(g):
+        dt = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if pre != 1.0:
+            g = g / pre
+        g = lax.psum(g, axes)
+        if gradient_average:
+            g = g / (world / pre)
+        elif pre != 1.0:
+            g = g * pre
+        return g.astype(dt)
+
+    return jax.tree.map(_reduce, grads)
+
+
+class DistributedDataParallel:
+    """Thin functional counterpart of apex.parallel.DistributedDataParallel.
+
+    Wraps a loss function so its gradients come back already averaged over
+    the DP axes; parameter "broadcast at construction" (distributed.py:253)
+    is a non-event because SPMD params are replicated by sharding.
+
+    >>> ddp = DistributedDataParallel(loss_fn, allreduce_always_fp32=True)
+    >>> loss, grads = ddp.value_and_grad(params, batch)   # inside shard_map
+    """
+
+    def __init__(
+        self,
+        loss_fn,
+        axes: AxisNames = (AXIS_DATA, AXIS_CONTEXT),
+        *,
+        allreduce_always_fp32: bool = False,
+        gradient_average: bool = True,
+        gradient_predivide_factor: float = 1.0,
+    ):
+        self.loss_fn = loss_fn
+        self.axes = axes
+        self.opts = dict(
+            allreduce_always_fp32=allreduce_always_fp32,
+            gradient_average=gradient_average,
+            gradient_predivide_factor=gradient_predivide_factor,
+        )
+
+    def value_and_grad(self, params, *args, **kwargs):
+        loss, grads = jax.value_and_grad(self.loss_fn)(params, *args, **kwargs)
+        return loss, allreduce_gradients(grads, self.axes, **self.opts)

@@ -233,8 +233,9 @@ def get_policy(opt_level: Union[str, Policy] = "O1", **overrides) -> Policy:
 # Module-path patterns that mark normalization layers (kept fp32 under
 # keep_batchnorm_fp32, like apex's _BatchNorm re-float, fp16util.py:42-49):
 # any name containing "norm" (batchnorm, layernorm, BatchNorm_0, norm1, ...)
-# or a standalone bn token ("bn", "bn1", "bn_2", "downsample_bn").
-_BN_TOKEN_RE = re.compile(r"(^|[._/])bn\d*([._/]|$)")
+# or a standalone bn/ln token ("bn", "bn1", "bn_2", "ln1", "ln_f",
+# "downsample_bn").
+_BN_TOKEN_RE = re.compile(r"(^|[._/])(bn|ln)\d*([._/]|$)")
 
 
 def _name_is_norm(name: str) -> bool:

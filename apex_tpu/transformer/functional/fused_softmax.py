@@ -63,17 +63,16 @@ class FusedScaleMaskSoftmax:
         if self.mask_func is not None and mask is not None:
             mask = self.mask_func(mask)
         out_dtype = jnp.float32 if self.softmax_in_fp32 else x.dtype
-        if not self.fused:
+        sq, sk = x.shape[-2], x.shape[-1]
+        if not (self.fused and self.is_kernel_available(sq, sk)):
+            # The reference's is_kernel_available gate (fused_softmax.py:151-171)
+            # falling back to the unfused path.
             y = scaled_masked_softmax_reference(x, mask, scale, causal=causal)
-        elif causal:
-            if mask is not None:
-                # causal + padding mask: fold the boolean mask into the fused
-                # masked kernel by pre-masking, then apply the causal kernel.
-                y = scaled_masked_softmax_reference(x, mask, scale, causal=True)
-            else:
-                y = scaled_upper_triang_masked_softmax(x, scale)
+        elif causal and mask is None:
+            y = scaled_upper_triang_masked_softmax(x, scale)
         else:
-            y = scaled_masked_softmax(x, mask, scale)
+            # Covers padding, and causal+padding in one fused pass.
+            y = scaled_masked_softmax(x, mask, scale, causal=causal)
         return y.astype(out_dtype)
 
     @staticmethod

@@ -27,12 +27,11 @@ import jax
 from jax import lax
 
 from apex_tpu.parallel.mesh import AXIS_MODEL
+from apex_tpu.transformer.tensor_parallel.utils import divide
 
 
 def _local_slice(x, axis_name: str, dim: int = -1):
     """This rank's chunk of ``x`` along ``dim`` (mappings.py _split, :75-87)."""
-    from apex_tpu.transformer.tensor_parallel.utils import divide
-
     n = lax.axis_size(axis_name)
     dim = dim % x.ndim
     size = divide(x.shape[dim], n)  # the reference's divisibility guard

@@ -1,0 +1,108 @@
+"""Headline benchmark: GPT-2 345M mixed-precision training step on one chip.
+
+Measures the framework's core promise — the reference's amp-O2 + fused-kernel
+recipe (BASELINE.md targets 3/4: fused step vs unfused eager) — as tokens/sec
+for a full train step (forward + backward + FusedAdam + dynamic loss scaling)
+on GPT-2 345M, bf16 O2 policy with Pallas flash attention and fused LN.
+
+``vs_baseline`` is the speedup over the same model trained the "Python-only
+build" way the reference warns is slower (README.md:134-139): fp32 O0, unfused
+XLA attention/LN, plain optax Adam.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+# Plugin platforms registered by sitecustomize (the axon TPU tunnel) ignore a
+# plain JAX_PLATFORMS env var; force the selection before first backend use.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+
+def build(policy_level: str, impl: str):
+    import optax
+
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+
+    fused = policy_level == "O2"
+    cfg = GPTConfig(
+        vocab_size=50304,
+        hidden_size=int(os.environ.get("BENCH_HIDDEN", "1024")),
+        num_layers=int(os.environ.get("BENCH_LAYERS", "24")),
+        num_attention_heads=16,
+        max_seq_len=1024,
+        hidden_dropout=0.0,
+        axis=None,
+        compute_dtype=jnp.bfloat16 if fused else jnp.float32,
+        remat=True,
+        attention_impl=impl,
+    )
+    model = GPTModel(cfg)
+    policy = amp.get_policy(policy_level)
+    opt = FusedAdam(lr=1e-4) if fused else optax.adam(1e-4)
+    mp_opt = amp.MixedPrecisionOptimizer(opt, policy)
+    params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    opt_state = mp_opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        def scaled_loss(p):
+            return mp_opt.scale_loss(model.loss(p, tokens, targets), opt_state)
+
+        loss_s, grads_s = jax.value_and_grad(scaled_loss)(params)
+        new_params, new_state, metrics = mp_opt.apply_gradients(
+            opt_state, params, grads_s
+        )
+        return new_params, new_state, loss_s, metrics
+
+    return train_step, params, opt_state
+
+
+def measure(train_step, params, opt_state, batch, seq, steps=10) -> float:
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 50304)
+    targets = jnp.roll(tokens, -1, axis=-1)
+    # warmup / compile
+    params, opt_state, loss, _ = train_step(params, opt_state, tokens, targets)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss, _ = train_step(params, opt_state, tokens, targets)
+    jax.block_until_ready(params)
+    dt = (time.perf_counter() - t0) / steps
+    assert jnp.isfinite(loss), "non-finite loss in bench"
+    return batch * seq / dt
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = 1024
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    print(f"platform: {jax.default_backend()}", file=sys.stderr)
+
+    fused_tps = measure(*build("O2", "auto"), batch, seq, steps)
+    print(f"O2+fused: {fused_tps:.0f} tokens/s", file=sys.stderr)
+    base_tps = measure(*build("O0", "xla"), batch, seq, steps)
+    print(f"O0 fp32 unfused: {base_tps:.0f} tokens/s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "gpt2_345m_o2_train_tokens_per_sec",
+        "value": round(fused_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(fused_tps / base_tps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

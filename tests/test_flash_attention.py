@@ -146,3 +146,16 @@ def test_fused_scale_mask_softmax_module():
     s = np.asarray(yc, np.float32).sum(-1)
     np.testing.assert_allclose(s, np.ones_like(s), rtol=2e-2)
     assert np.asarray(yc, np.float32)[0, 0, 0, 1:].max() == 0.0
+
+    # causal + padding mask composed in one fused pass
+    both = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal)(x, mask)
+    ref_both = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal,
+                                     fused=False)(x, mask)
+    np.testing.assert_allclose(np.asarray(both), np.asarray(ref_both),
+                               rtol=2e-2, atol=2e-2)
+
+    # unaligned sk falls back to the unfused path instead of the kernel
+    x_odd = jax.random.normal(jax.random.PRNGKey(8), (2, 2, 12, 30))
+    y_odd = FusedScaleMaskSoftmax()(x_odd)
+    ref_odd = FusedScaleMaskSoftmax(fused=False)(x_odd)
+    np.testing.assert_allclose(np.asarray(y_odd), np.asarray(ref_odd), rtol=1e-5)
