@@ -68,6 +68,46 @@ def allreduce_gradients(
     return jax.tree.map(_reduce, grads)
 
 
+def allreduce_gradients_by_spec(
+    grads: Any,
+    specs: Any,
+    *,
+    data_axes: AxisNames = (AXIS_DATA, AXIS_CONTEXT),
+    replicated_axes: Sequence[str] = ("pipe",),
+    **opts,
+) -> Any:
+    """Spec-aware gradient reduction for hybrid-parallel training.
+
+    Every grad averages over the data axes; grads of params *replicated*
+    over an axis in ``replicated_axes`` (the axis does not appear in their
+    PartitionSpec) are additionally **summed** over it. Under the SPMD
+    pipeline this is exactly the reference's embedding-group allreduce for
+    tied embeddings (parallel_state.py:165-184): stage-masked contributions
+    (input embedding on the first stage, LM head on the last) sum to the
+    total tied gradient.
+    """
+    data_axes = (data_axes,) if isinstance(data_axes, str) else tuple(data_axes)
+
+    def _reduce(g, spec):
+        g = allreduce_gradients(g, data_axes, **opts)
+        spec_axes = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            spec_axes.update((entry,) if isinstance(entry, str) else entry)
+        extra = tuple(a for a in replicated_axes if a not in spec_axes)
+        if extra:
+            g = lax.psum(g, extra)
+        return g
+
+    from jax.sharding import PartitionSpec
+
+    return jax.tree.map(
+        _reduce, grads, specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
 class DistributedDataParallel:
     """Thin functional counterpart of apex.parallel.DistributedDataParallel.
 

@@ -1,0 +1,8 @@
+"""Pipeline parallelism (reference: apex/transformer/pipeline_parallel/)."""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    get_forward_backward_func,
+    pipeline_specs,
+    pipelined_loss_fn,
+)

@@ -1,0 +1,246 @@
+"""Pipeline-parallel schedules, single-program SPMD (reference:
+apex/transformer/pipeline_parallel/schedules/).
+
+The reference drives 1F1B with a host loop per rank: batched NCCL
+isend/irecv between stages (p2p_communication.py:29-184), explicit
+warmup/steady/cooldown phases (fwd_bwd_pipelining_without_interleaving.py:
+155-345), and a ``torch.cuda.synchronize`` after every p2p batch — a
+host-latency-bound design that eager CUDA forces.
+
+The TPU-native schedule is **one jitted SPMD program** over the ``pipe`` mesh
+axis:
+
+- the stacked layer parameters are sharded on their leading (layer) dim over
+  ``pipe`` — a device's shard *is* its stage;
+- a ``lax.scan`` over M + S - 1 "ticks" rotates activations between stages
+  with ``ppermute`` (the p2p ring), every stage computing every tick
+  (uniform SPMD; fill/drain bubbles are the idle ticks, fraction
+  (S-1)/(M+S-1), the reference's warmup+cooldown);
+- **backward is the AD transpose of the forward scan** — reversing the scan
+  and the ppermutes mechanically yields the drain-side pipeline the
+  reference hand-writes as its cooldown phase. XLA sees forward+backward as
+  one program and overlaps compute with the permute collectives (the
+  side-stream overlap of p2p_communication, for free).
+
+Embedding and LM head run replicated across ``pipe`` (their FLOPs would
+otherwise idle in the bubble), but their *loss contribution is masked to the
+owning stage* — so a spec-aware psum over ``pipe`` recovers exactly the
+reference's embedding-tie allreduce over the embedding group
+(parallel_state.py:165-184): it sums the input-embedding contribution
+(stage 0) with the tied LM-head contribution (stage S-1).
+
+Interleaved virtual pipelining (reference
+fwd_bwd_pipelining_with_interleaving.py:25-333) runs as ``vpp`` sequential
+rings with Megatron's chunk placement — stage ``s`` chunk ``c`` holds the
+serial layer slab ``c*S + s`` (see :func:`interleave_stack`) — preserving the
+serial composition order and the per-stage memory layout of the interleaved
+schedule. (The bubble-overlap refinement of true interleaved 1F1B is a
+scheduling optimization on the same placement, left to a later round.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import AXIS_PIPE
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    reduce_from_tensor_model_parallel_region as _psum_identity_bwd,
+)
+
+
+def pipeline_specs(specs: Any, axis: str = AXIS_PIPE) -> Any:
+    """Shard a stacked-layer PartitionSpec tree's leading (layer) dim over
+    the pipeline axis — turning the scan stack into per-stage shards."""
+    return jax.tree.map(
+        lambda s: P(axis, *s[1:]),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def interleave_stack(layers: Any, pipeline_size: int, virtual_pipeline_size: int) -> Any:
+    """Permute a stacked layer tree so that, sharded over ``pipe``, stage
+    ``s``'s local chunk ``c`` holds serial layer slab ``c*S + s`` — the
+    interleaved-schedule placement (reference parallel_state.py:104-111 +
+    build_model's virtual chunks, schedules/common.py:52-65). Apply before
+    ``shard_params``; training/checkpointing in the permuted order is
+    self-consistent, and :func:`deinterleave_stack` restores serial order."""
+    S, vpp = pipeline_size, virtual_pipeline_size
+    L = jax.tree.leaves(layers)[0].shape[0]
+    if L % (S * vpp):
+        raise ValueError(f"num_layers ({L}) must divide by pp*vpp ({S * vpp})")
+    per = L // (S * vpp)
+    order = np.concatenate(
+        [np.arange(per) + (c * S + s) * per for s in range(S) for c in range(vpp)]
+    )
+    return jax.tree.map(lambda x: x[order], layers)
+
+
+def deinterleave_stack(layers: Any, pipeline_size: int, virtual_pipeline_size: int) -> Any:
+    S, vpp = pipeline_size, virtual_pipeline_size
+    L = jax.tree.leaves(layers)[0].shape[0]
+    per = L // (S * vpp)
+    order = np.concatenate(
+        [np.arange(per) + (c * S + s) * per for s in range(S) for c in range(vpp)]
+    )
+    inv = np.argsort(order)
+    return jax.tree.map(lambda x: x[inv], layers)
+
+
+def _broadcast_from(x: jax.Array, axis: str, src: int) -> jax.Array:
+    """Broadcast src's shard (AD: cotangent returns only to src — consistent
+    with stage-masked losses)."""
+    return lax.all_gather(x, axis, axis=0, tiled=False)[src]
+
+
+def _pipeline_ring(
+    run_stage: Callable[[Any, jax.Array], jax.Array],
+    layers_local: Any,
+    h_microbatches: jax.Array,  # (M, mb, ...) — replicated across pipe
+    axis: str,
+) -> jax.Array:
+    """Rotate M microbatches through the stage ring once. Returns completed
+    activations (M, mb, ...), valid on the last stage (garbage elsewhere)."""
+    S = lax.axis_size(axis)
+    s_idx = lax.axis_index(axis)
+    M = h_microbatches.shape[0]
+    n_ticks = M + S - 1
+
+    mb_shape = h_microbatches.shape[1:]
+    out0 = jnp.zeros((M,) + mb_shape, h_microbatches.dtype)
+    buf0 = jnp.zeros(mb_shape, h_microbatches.dtype)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        buf, out = carry
+        inject = jnp.minimum(t, M - 1)
+        h_in = jnp.where(s_idx == 0, h_microbatches[inject], buf)
+        h_out = run_stage(layers_local, h_in)
+        done = t - (S - 1)
+        idx = jnp.clip(done, 0, M - 1)
+        valid = (s_idx == S - 1) & (done >= 0)
+        cur = lax.dynamic_index_in_dim(out, idx, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, h_out, cur), idx, 0
+        )
+        buf = lax.ppermute(h_out, axis, perm)
+        return (buf, out), None
+
+    (_, out), _ = lax.scan(tick, (buf0, out0), jnp.arange(n_ticks))
+    return out
+
+
+def pipelined_loss_fn(
+    *,
+    embed: Callable[[Any, Any], jax.Array],
+    run_layers: Callable[[Any, jax.Array], jax.Array],
+    head_loss: Callable[[Any, jax.Array, Any], jax.Array],
+    num_microbatches: int,
+    axis: str = AXIS_PIPE,
+    virtual_pipeline_size: int = 1,
+) -> Callable:
+    """Build ``loss(params, layers_local, batch, targets) -> scalar`` running
+    the layer stack through the SPMD pipeline.
+
+    Args:
+      embed: ``(params, batch) -> (B, ...) activations`` (replicated work).
+      run_layers: ``(layer_chunk_params, h) -> h`` applying a stage chunk.
+      head_loss: ``(params, h, targets) -> per-element loss`` (replicated
+        work, masked to the last stage).
+      num_microbatches: M; the batch dim must divide by it.
+      axis: pipeline mesh axis (bound inside shard_map).
+      virtual_pipeline_size: interleaved chunks per stage; layer stacks must
+        be pre-permuted with :func:`interleave_stack` when > 1.
+
+    Run inside ``shard_map`` with layer params sharded by
+    :func:`pipeline_specs`; ``params`` holds the non-pipelined parameters
+    (embedding, head, final norm — replicated over ``axis``).
+    """
+    M = num_microbatches
+    vpp = virtual_pipeline_size
+
+    def loss_fn(params, layers_local, batch, targets):
+        S = lax.axis_size(axis)
+        h = embed(params, batch)
+        bsz = h.shape[0]
+        if bsz % M:
+            raise ValueError(f"batch ({bsz}) must divide by microbatches ({M})")
+        h_mb = h.reshape((M, bsz // M) + h.shape[1:])
+
+        n_local = jax.tree.leaves(layers_local)[0].shape[0]
+        per = n_local // vpp
+        for c in range(vpp):
+            chunk = jax.tree.map(lambda x: x[c * per:(c + 1) * per], layers_local)
+            out = _pipeline_ring(run_layers, chunk, h_mb, axis)
+            if c < vpp - 1:
+                # ring c's outputs (on the last stage) are ring c+1's inputs
+                # (injected by stage 0): hand them around the ring.
+                h_mb = _broadcast_from(out, axis, S - 1)
+
+        h_full = out.reshape((bsz,) + out.shape[2:])
+        per_loss = head_loss(params, h_full, targets)
+        # Only the last stage holds real outputs; mask then psum (identity
+        # backward, Megatron cotangent convention) so head/embedding grads
+        # attribute to their owning stage.
+        local = jnp.where(
+            lax.axis_index(axis) == S - 1,
+            jnp.mean(per_loss),
+            jnp.zeros((), per_loss.dtype),
+        )
+        return _psum_identity_bwd(local, axis)
+
+    return loss_fn
+
+
+def forward_backward_no_pipelining(
+    loss_fn: Callable,
+    params: Any,
+    batch: Any,
+    targets: Any,
+    num_microbatches: int,
+):
+    """Gradient accumulation over microbatches without pipelining
+    (reference: fwd_bwd_no_pipelining.py:31+ — grad sync once at the end,
+    which a single traced scan gives by construction).
+
+    Returns ``(mean_loss, mean_grads)``.
+    """
+    M = num_microbatches
+
+    def split(x):
+        return x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+    b_mb = jax.tree.map(split, batch)
+    t_mb = jax.tree.map(split, targets)
+
+    def body(carry, xs):
+        acc_loss, acc_grads = carry
+        b, t = xs
+        l, g = jax.value_and_grad(loss_fn)(params, b, t)
+        return (acc_loss + l, jax.tree.map(jnp.add, acc_grads, g)), None
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    (loss, grads), _ = lax.scan(body, (jnp.zeros(()), zero_grads), (b_mb, t_mb))
+    scale = 1.0 / M
+    return loss * scale, jax.tree.map(lambda g: g * scale, grads)
+
+
+def get_forward_backward_func(
+    pipeline_model_parallel_size: int,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+):
+    """Dispatcher (reference: schedules/__init__.py:16-34): no-pipelining for
+    pp=1; the SPMD pipeline (with or without interleaving) otherwise."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return lambda **kw: pipelined_loss_fn(
+                virtual_pipeline_size=virtual_pipeline_model_parallel_size, **kw
+            )
+        return pipelined_loss_fn
+    return forward_backward_no_pipelining

@@ -1,0 +1,169 @@
+"""Pipeline-parallel schedule tests.
+
+Reference pattern: tests/L0/run_transformer/run_pipeline_parallel_test.py —
+sweep {no_pipelining, 1F1B, interleaved} and assert loss parity; the SPMD
+pipeline must match the serial model bit-for-tolerance (forward AND grads)
+because it computes the identical function.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.parallel.distributed import allreduce_gradients_by_spec
+from apex_tpu.transformer import tensor_parallel as tp
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    pipeline_specs,
+    pipelined_loss_fn,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    deinterleave_stack,
+    interleave_stack,
+)
+
+TINY = dict(
+    vocab_size=64,
+    hidden_size=32,
+    num_layers=4,
+    num_attention_heads=4,
+    max_seq_len=16,
+    hidden_dropout=0.0,
+    compute_dtype=jnp.float32,
+    remat=False,
+)
+
+
+def _setup(pp, tp_size=1, vpp=1):
+    mesh = mesh_lib.make_virtual_mesh(
+        pp * tp_size, tensor_model_parallel_size=tp_size,
+        pipeline_model_parallel_size=pp,
+    )
+    axis = "model" if tp_size > 1 else None
+    serial = GPTModel(GPTConfig(axis=None, **TINY))
+    par = GPTModel(GPTConfig(axis=axis, **TINY))
+    params = serial.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    tgt = jnp.roll(toks, -1, axis=-1)
+    return mesh, serial, par, params, toks, tgt
+
+
+def _pipeline_value_and_grad(par, mesh, params, toks, tgt, M, vpp=1):
+    specs = par.specs()
+    layer_specs = pipeline_specs(specs["layers"])
+    rest_specs = {k: v for k, v in specs.items() if k != "layers"}
+    layers = params["layers"]
+    if vpp > 1:
+        layers = interleave_stack(layers, mesh.shape["pipe"], vpp)
+    rest = {k: v for k, v in params.items() if k != "layers"}
+    sharded_layers = tp.shard_params(layers, layer_specs, mesh)
+    sharded_rest = tp.shard_params(rest, rest_specs, mesh)
+
+    loss_fn = pipelined_loss_fn(
+        embed=par.embed,
+        run_layers=lambda lp, h: par.run_layers(lp, h),
+        head_loss=lambda p, h, t: par.head(p, h, t),
+        num_microbatches=M,
+        virtual_pipeline_size=vpp,
+    )
+
+    def step(rest, layers, toks, tgt):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            rest, layers, toks, tgt
+        )
+        rest_g, layer_g = grads
+        rest_g = allreduce_gradients_by_spec(rest_g, rest_specs)
+        return loss, rest_g, layer_g
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(rest_specs, layer_specs, P(), P()),
+        out_specs=(P(), rest_specs, layer_specs),
+        check_vma=False,
+    ))
+    loss, rest_g, layer_g = fn(sharded_rest, sharded_layers, toks, tgt)
+    layer_g = jax.device_get(layer_g)
+    if vpp > 1:
+        layer_g = deinterleave_stack(layer_g, mesh.shape["pipe"], vpp)
+    return float(loss), jax.device_get(rest_g), layer_g
+
+
+@pytest.mark.parametrize("pp,vpp", [(2, 1), (4, 1), (2, 2)])
+def test_pipeline_matches_serial(pp, vpp):
+    mesh, serial, par, params, toks, tgt = _setup(pp)
+    try:
+        v_s, g_s = jax.value_and_grad(serial.loss)(params, toks, tgt)
+        loss, rest_g, layer_g = _pipeline_value_and_grad(
+            par, mesh, params, toks, tgt, M=4, vpp=vpp
+        )
+        np.testing.assert_allclose(float(v_s), loss, rtol=1e-5)
+        for name in ("embedding", "position", "ln_f"):
+            a = jax.tree.leaves(g_s[name])
+            b = jax.tree.leaves(rest_g[name])
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(x, np.asarray(y), rtol=2e-4, atol=2e-4,
+                                           err_msg=name)
+        for x, y in zip(jax.tree.leaves(g_s["layers"]), jax.tree.leaves(layer_g)):
+            np.testing.assert_allclose(x, np.asarray(y), rtol=2e-4, atol=2e-4)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_pipeline_with_tensor_parallel():
+    """Hybrid PP×TP on 8 virtual devices (the gpt_scaling_test.py (2,1,4) /
+    (1,2,4) configs)."""
+    mesh, serial, par, params, toks, tgt = _setup(pp=2, tp_size=2)
+    try:
+        v_s = float(serial.loss(params, toks, tgt))
+        loss, _, _ = _pipeline_value_and_grad(par, mesh, params, toks, tgt, M=2)
+        np.testing.assert_allclose(v_s, loss, rtol=1e-5)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_no_pipelining_grad_accumulation_matches_full_batch():
+    model = GPTModel(GPTConfig(axis=None, **TINY))
+    params = model.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    tgt = jnp.roll(toks, -1, axis=-1)
+    loss_fn = lambda p, b, t: model.loss(p, b, t)
+    l_acc, g_acc = forward_backward_no_pipelining(loss_fn, params, toks, tgt, 4)
+    l_full, g_full = jax.value_and_grad(model.loss)(params, toks, tgt)
+    np.testing.assert_allclose(float(l_full), float(l_acc), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_acc)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_interleave_stack_round_trip():
+    layers = {"w": jnp.arange(8.0)[:, None] * jnp.ones((8, 3))}
+    perm = interleave_stack(layers, 2, 2)
+    # stage 0 (first half) must hold slabs 0 and 2; stage 1 slabs 1 and 3
+    np.testing.assert_array_equal(np.asarray(perm["w"][:, 0]),
+                                  [0, 1, 4, 5, 2, 3, 6, 7])
+    back = deinterleave_stack(perm, 2, 2)
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(layers["w"]))
+
+
+def test_microbatch_calculators():
+    c = build_num_microbatches_calculator(64, 4, 2)
+    assert isinstance(c, ConstantNumMicroBatches)
+    assert c.get() == 8
+    r = build_num_microbatches_calculator(64, 4, 2, rampup_batch_size=[16, 16, 300])
+    assert isinstance(r, RampupBatchsizeNumMicroBatches)
+    assert r.get_current_global_batch_size() == 16
+    r.update(150, True)
+    assert r.get_current_global_batch_size() == 32
+    r.update(400, True)
+    assert r.get_current_global_batch_size() == 64
+    assert r.get() == 8
+    with pytest.raises(ValueError):
+        build_num_microbatches_calculator(63, 4, 2)
