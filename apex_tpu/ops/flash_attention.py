@@ -62,12 +62,17 @@ def _supported(sq: int, sk: int, d: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *, scale, causal, blk_q, blk_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, off_ref, o_ref, lse_ref, *, scale, causal, blk_q, blk_k):
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
     sk = k_ref.shape[2]
     d = q.shape[-1]
     qi = pl.program_id(2)
     nk = sk // blk_k
+    # Global-position offsets of this q/k shard (ring attention over the
+    # ``context`` axis passes the shard's start positions so causal masking
+    # is correct across sequence shards; 0 for unsharded attention).
+    q_off = off_ref[0] if off_ref is not None else 0
+    k_off = off_ref[1] if off_ref is not None else 0
 
     def body(j, carry):
         acc, m, l = carry
@@ -79,8 +84,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *, scale, causal, bl
         if b_ref is not None:
             s = s + b_ref[0, 0, :, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
         if causal:
-            q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_off + j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos > q_pos, _NEG_INF, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -98,7 +103,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *, scale, causal, bl
         # skip k-blocks strictly above the diagonal (fully masked): the
         # triangular-work saving the reference's upper-triang kernel gets
         # from its tiling (scaled_upper_triang_masked_softmax.h).
-        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * blk_q, blk_k))
+        lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
+        nk = jnp.clip(lim, 0, nk)
     acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m0, l0))
     # Fully-masked rows (possible with an all -inf bias row) have l == 0.
     l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -112,7 +118,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *, scale, causal, bl
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref, dq_ref, db_ref,
+    q_ref, k_ref, v_ref, b_ref, off_ref, do_ref, lse_ref, delta_ref, dq_ref, db_ref,
     *, scale, causal, blk_q, blk_k, b_bcast, h_bcast, dims,
 ):
     q = q_ref[0, 0].astype(jnp.float32)
@@ -124,6 +130,8 @@ def _bwd_dq_kernel(
     # _flash_bwd orders the grid so dbias revisits are *consecutive*.
     qi = pl.program_id(dims["q"])
     nk = sk // blk_k
+    q_off = off_ref[0] if off_ref is not None else 0
+    k_off = off_ref[1] if off_ref is not None else 0
 
     if db_ref is not None:
         # A bias broadcast over batch/heads maps several grid steps onto the
@@ -157,8 +165,8 @@ def _bwd_dq_kernel(
         if b_ref is not None:
             s = s + b_ref[0, 0, :, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
         if causal:
-            q_pos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_off + j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos > q_pos, _NEG_INF, s)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
@@ -171,13 +179,14 @@ def _bwd_dq_kernel(
         return dq + scale * jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     if causal:
-        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * blk_q, blk_k))
+        lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
+        nk = jnp.clip(lim, 0, nk)
     dq = jax.lax.fori_loop(0, nk, body, jnp.zeros_like(q))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    q_ref, k_ref, v_ref, b_ref, off_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     *, scale, causal, blk_q, blk_k,
 ):
     k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, d)
@@ -185,6 +194,8 @@ def _bwd_dkv_kernel(
     sq = q_ref.shape[2]
     ki = pl.program_id(2)
     nq = sq // blk_q
+    q_off = off_ref[0] if off_ref is not None else 0
+    k_off = off_ref[1] if off_ref is not None else 0
 
     def body(i, carry):
         dk, dv = carry
@@ -198,8 +209,8 @@ def _bwd_dkv_kernel(
         if b_ref is not None:
             s = s + b_ref[0, 0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
         if causal:
-            q_pos = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = q_off + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_off + ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos > q_pos, _NEG_INF, s)
         p = jnp.exp(s - lse)  # (blk_q, blk_k)
         dv_new = dv + jax.lax.dot_general(
@@ -218,7 +229,7 @@ def _bwd_dkv_kernel(
     dv0 = jnp.zeros_like(v)
     # Under causal masking, q-blocks entirely left of this k-block's diagonal
     # contribute nothing — start at the first intersecting block.
-    start = (ki * blk_k) // blk_q if causal else 0
+    start = jnp.clip((k_off - q_off + ki * blk_k) // blk_q, 0, nq) if causal else 0
     dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
@@ -256,10 +267,15 @@ def _dq_grid_order(bias, b_bcast, h_bcast):
     return ("q", "b", "h")  # h broadcast, or both, or neither
 
 
+def _offsets_spec():
+    """SMEM spec for the (q_off, k_off) global-position scalars."""
+    return pl.BlockSpec((2,), lambda *_: (0,), memory_space=pltpu.SMEM)
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "causal", "blk_q", "blk_k")
 )
-def _flash_fwd(q, k, v, bias, *, scale, causal, blk_q, blk_k):
+def _flash_fwd(q, k, v, bias, offsets, *, scale, causal, blk_q, blk_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     grid = (b, h, sq // blk_q)
@@ -270,15 +286,28 @@ def _flash_fwd(q, k, v, bias, *, scale, causal, blk_q, blk_k):
     ospec = qspec
     lspec = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM)
-    bspec = None if bias is None else _bias_spec(bias, blk_q, sk)
-    in_specs = [qspec, kspec, kspec] + ([bspec] if bias is not None else [])
-    args = (q, k, v) + ((bias,) if bias is not None else ())
+    in_specs = [qspec, kspec, kspec]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias, blk_q, sk))
+        args.append(bias)
+    if offsets is not None:
+        in_specs.append(_offsets_spec())
+        args.append(offsets)
+    has_bias, has_off = bias is not None, offsets is not None
 
-    kern = functools.partial(
-        _fwd_kernel if bias is not None else
-        (lambda qr, kr, vr, orf, lr, **kw: _fwd_kernel(qr, kr, vr, None, orf, lr, **kw)),
-        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-    )
+    def kern(*refs):
+        refs = list(refs)
+        qr, kr, vr = refs[:3]
+        i = 3
+        br = refs[i] if has_bias else None
+        i += has_bias
+        offr = refs[i] if has_off else None
+        i += has_off
+        orf, lr = refs[i], refs[i + 1]
+        _fwd_kernel(qr, kr, vr, br, offr, orf, lr,
+                    scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
@@ -296,7 +325,7 @@ def _flash_fwd(q, k, v, bias, *, scale, causal, blk_q, blk_k):
 @functools.partial(
     jax.jit, static_argnames=("scale", "causal", "blk_q", "blk_k")
 )
-def _flash_bwd(q, k, v, bias, o, lse, do, *, scale, causal, blk_q, blk_k):
+def _flash_bwd(q, k, v, bias, offsets, o, lse, do, *, scale, causal, blk_q, blk_k):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
@@ -337,16 +366,24 @@ def _flash_bwd(q, k, v, bias, o, lse, do, *, scale, causal, blk_q, blk_k):
             memory_space=pltpu.VMEM,
         ))
         args.append(bias)
+    if offsets is not None:
+        in_specs.append(_offsets_spec())
+        args.append(offsets)
     in_specs += [qspec, lblk, lblk]
     args += [do, lse, delta]
+    has_bias, has_off = bias is not None, offsets is not None
 
     def dq_kern(*refs):
-        if bias is not None:
-            qr, kr, vr, br, dor, lr, dr, dqr, dbr = refs
-        else:
-            qr, kr, vr, dor, lr, dr, dqr = refs
-            br = dbr = None
-        _bwd_dq_kernel(qr, kr, vr, br, dor, lr, dr, dqr, dbr,
+        refs = list(refs)
+        qr, kr, vr = refs[:3]
+        i = 3
+        br = refs[i] if has_bias else None
+        i += has_bias
+        offr = refs[i] if has_off else None
+        i += has_off
+        dor, lr, dr, dqr = refs[i:i + 4]
+        dbr = refs[i + 4] if has_bias else None
+        _bwd_dq_kernel(qr, kr, vr, br, offr, dor, lr, dr, dqr, dbr,
                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
                        b_bcast=b_bcast, h_bcast=h_bcast, dims=dims)
 
@@ -387,16 +424,22 @@ def _flash_bwd(q, k, v, bias, o, lse, do, *, scale, causal, blk_q, blk_k):
         )
         in_specs2.append(bspec2)
         args2.append(bias)
+    if offsets is not None:
+        in_specs2.append(_offsets_spec())
+        args2.append(offsets)
     in_specs2 += [qfull, lfull, lfull]
     args2 += [do, lse, delta]
 
     def dkv_kern(*refs):
-        if bias is not None:
-            qr, kr, vr, br, dor, lr, dr, dkr, dvr = refs
-        else:
-            qr, kr, vr, dor, lr, dr, dkr, dvr = refs
-            br = None
-        _bwd_dkv_kernel(qr, kr, vr, br, dor, lr, dr, dkr, dvr,
+        refs = list(refs)
+        qr, kr, vr = refs[:3]
+        i = 3
+        br = refs[i] if has_bias else None
+        i += has_bias
+        offr = refs[i] if has_off else None
+        i += has_off
+        dor, lr, dr, dkr, dvr = refs[i:i + 5]
+        _bwd_dkv_kernel(qr, kr, vr, br, offr, dor, lr, dr, dkr, dvr,
                         scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
 
     dk, dv = pl.pallas_call(
@@ -420,20 +463,20 @@ def _flash_bwd(q, k, v, bias, o, lse, do, *, scale, causal, blk_q, blk_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, bias, scale, causal, blk_q, blk_k):
-    o, _ = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+    o, _ = _flash_fwd(q, k, v, bias, None, scale=scale, causal=causal,
                       blk_q=blk_q, blk_k=blk_k)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, bias, scale, causal, blk_q, blk_k):
-    o, lse = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+    o, lse = _flash_fwd(q, k, v, bias, None, scale=scale, causal=causal,
                         blk_q=blk_q, blk_k=blk_k)
     return o, (q, k, v, bias, o, lse)
 
 
 def _flash_vjp_bwd(scale, causal, blk_q, blk_k, res, do):
     q, k, v, bias, o, lse = res
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, do, scale=scale,
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, None, o, lse, do, scale=scale,
                                    causal=causal, blk_q=blk_q, blk_k=blk_k)
     if dbias is not None:
         dbias = dbias.astype(bias.dtype)

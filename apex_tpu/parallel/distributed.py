@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_DATA
+from apex_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_DATA, AXIS_PIPE
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -73,7 +73,7 @@ def allreduce_gradients_by_spec(
     specs: Any,
     *,
     data_axes: AxisNames = (AXIS_DATA, AXIS_CONTEXT),
-    replicated_axes: Sequence[str] = ("pipe",),
+    replicated_axes: Sequence[str] = (AXIS_PIPE,),
     **opts,
 ) -> Any:
     """Spec-aware gradient reduction for hybrid-parallel training.

@@ -12,6 +12,8 @@ sharding constraints under ``pjit``.
 - ``pipeline_parallel`` — 1F1B / interleaved schedules, microbatches
 - ``functional``        — fused scale-mask-softmax module
 - ``amp``               — model-parallel-aware grad scaler
+- ``ring``              — ring attention + Ulysses sequence parallelism over
+                          the ``context`` axis (new vs the reference)
 """
 
 from apex_tpu.transformer import parallel_state  # noqa: F401
@@ -19,3 +21,5 @@ from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401
 from apex_tpu.transformer import microbatches  # noqa: F401
 from apex_tpu.transformer import functional  # noqa: F401
+from apex_tpu.transformer import ring  # noqa: F401
+from apex_tpu.transformer.ring import ring_attention, ulysses_attention  # noqa: F401

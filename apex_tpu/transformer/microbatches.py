@@ -91,6 +91,12 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
         )
         if start_batch_size % self.micro_batch_times_data_parallel_size != 0:
             raise ValueError("start batch size not divisible by mb*dp")
+        if global_batch_size % self.micro_batch_times_data_parallel_size != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) not divisible by "
+                f"micro-batch size x data-parallel size "
+                f"({self.micro_batch_times_data_parallel_size})"
+            )
         diff = global_batch_size - start_batch_size
         if diff < 0 or diff % batch_size_increment != 0:
             raise ValueError(
@@ -113,11 +119,17 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
             self.current_global_batch_size = min(
                 self.current_global_batch_size, self.global_batch_size
             )
-        # round down to a multiple of mb*dp (reference :158-165)
         mbdp = self.micro_batch_times_data_parallel_size
+        # consistency check BEFORE rounding (reference :158-165 raises when
+        # the ramped size is not a multiple of mb*dp and checking is on).
+        if consistency_check and self.current_global_batch_size % mbdp != 0:
+            raise RuntimeError(
+                f"ramped global batch size ({self.current_global_batch_size}) "
+                f"is not divisible by micro-batch size x data-parallel size "
+                f"({mbdp})"
+            )
+        # otherwise round down to a multiple of mb*dp
         self.current_global_batch_size = max(
             mbdp, (self.current_global_batch_size // mbdp) * mbdp
         )
-        if consistency_check and self.current_global_batch_size % mbdp != 0:
-            raise RuntimeError("ramped batch size not divisible by mb*dp")
         self.num_micro_batches = self.current_global_batch_size // mbdp

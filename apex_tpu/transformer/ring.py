@@ -1,0 +1,244 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all attention.
+
+**New capability relative to the reference** (SURVEY.md §2.3 row "SP" — the
+apex snapshot predates Megatron sequence parallelism; its long-sequence story
+is activation checkpointing plus the sk≤2048 fused-softmax fallback,
+apex/transformer/functional/fused_softmax.py:151-171). On TPU, long context is
+a first-class axis: sequences shard over the ``context`` mesh axis and
+attention runs as a **ring** — each step computes blockwise attention against
+the resident K/V shard while ``ppermute`` rotates K/V one hop around the ICI
+ring, overlapping communication with the flash-attention compute
+(the published Ring Attention recipe over XLA collectives).
+
+Two schemes, both built on the Pallas flash kernel (apex_tpu.ops.flash_attention):
+
+- ``ring_attention``: K/V rotate; sequence length per device is bounded only
+  by HBM. Causal masking stays exact across shards by passing each shard's
+  global position offsets into the kernel. The ring replaces the reference's
+  batched ``isend/irecv`` p2p machinery (pipeline_parallel/p2p_communication.py:29-67)
+  with a collective permute the XLA scheduler can overlap.
+- ``ulysses_attention``: all-to-all reshard (seq-sharded → head-sharded), full
+  attention locally, all-to-all back. Cheaper at moderate sequence lengths
+  when heads ≥ context size; differentiability is plain AD through
+  ``lax.all_to_all``.
+
+Both must be called **inside a shard_map** binding the context axis, with
+``q/k/v`` laid out ``(batch, heads, local_seq, head_dim)``.
+
+Backward pass of the ring: a second ring pass — dQ accumulates locally with
+the *global* logsumexp saved from forward; dK/dV accumulators travel the ring
+alongside their K/V shard, arriving back at the owning device after a full
+rotation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops.flash_attention import (
+    _flash_bwd,
+    _flash_fwd,
+    _pick_block,
+    _supported,
+)
+from apex_tpu.ops.layer_norm import _resolve_impl
+from apex_tpu.parallel.mesh import AXIS_CONTEXT
+
+_NEG_BIG = -1e30
+
+
+def _shift(tree, axis: str):
+    """Send to the next rank on the ring (rank + 1, wrapping)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def _combine(o, lse, o_s, lse_s):
+    """Merge two partial softmax results via their logsumexps."""
+    lse_new = jnp.logaddexp(lse, lse_s)
+    o_new = o * jnp.exp(lse - lse_new) + o_s * jnp.exp(lse_s - lse_new)
+    return o_new, lse_new
+
+
+def _step_offsets(rank, step, n, sq, sk):
+    """Global position offsets (q_off, k_off) at ring step ``step``: after
+    ``step`` shifts, this device holds the K/V shard of rank - step."""
+    src = jnp.mod(rank - step, n)
+    return jnp.stack([rank * sq, src * sk]).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Pallas ring (custom_vjp: forward ring + backward ring)
+# ---------------------------------------------------------------------------
+
+
+def _ring_fwd(q, k, v, axis, causal, scale, blk_q, blk_k):
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    sq, sk = q.shape[2], k.shape[2]
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((*q.shape[:3], 1), _NEG_BIG, jnp.float32)
+    kv = (k, v)
+    for s in range(n):
+        offs = _step_offsets(rank, s, n, sq, sk) if causal else None
+        o_s, lse_s = _flash_fwd(
+            q, kv[0], kv[1], None, offs,
+            scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+        )
+        o, lse = _combine(o, lse, o_s.astype(jnp.float32), lse_s)
+        if s != n - 1:
+            kv = _shift(kv, axis)
+    return o.astype(q.dtype), lse
+
+
+def _ring_bwd(q, k, v, o, lse, do, axis, causal, scale, blk_q, blk_k):
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    sq, sk = q.shape[2], k.shape[2]
+    dq = jnp.zeros(q.shape, jnp.float32)
+    ring = (k, v, jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    for s in range(n):
+        k_s, v_s, dk_acc, dv_acc = ring
+        offs = _step_offsets(rank, s, n, sq, sk) if causal else None
+        dq_s, dk_s, dv_s, _ = _flash_bwd(
+            q, k_s, v_s, None, offs, o, lse, do,
+            scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+        )
+        dq = dq + dq_s.astype(jnp.float32)
+        ring = (k_s, v_s, dk_acc + dk_s.astype(jnp.float32),
+                dv_acc + dv_s.astype(jnp.float32))
+        # Shift after EVERY step (incl. the last): after n shifts each K/V
+        # shard — and the dK/dV accumulated along its journey — is home.
+        ring = _shift(ring, axis)
+    _, _, dk, dv = ring
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis, causal, scale, blk_q, blk_k):
+    o, _ = _ring_fwd(q, k, v, axis, causal, scale, blk_q, blk_k)
+    return o
+
+
+def _ring_vjp_fwd(q, k, v, axis, causal, scale, blk_q, blk_k):
+    o, lse = _ring_fwd(q, k, v, axis, causal, scale, blk_q, blk_k)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_vjp_bwd(axis, causal, scale, blk_q, blk_k, res, do):
+    q, k, v, o, lse = res
+    return _ring_bwd(q, k, v, o, lse, do, axis, causal, scale, blk_q, blk_k)
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback ring (plain AD through the rotation loop) — used for shapes the
+# Pallas envelope rejects, mirroring flash_attention's impl fallback.
+# ---------------------------------------------------------------------------
+
+
+def _partial_attn_xla(q, k, v, q_off, k_off, causal, scale):
+    """One shard-pair partial attention returning (unnormalized o, lse)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[2])[:, None]
+        k_pos = k_off + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(k_pos > q_pos, _NEG_BIG, s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return o / l_safe, m + jnp.log(l_safe)
+
+
+def _ring_xla(q, k, v, axis, causal, scale):
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    sq, sk = q.shape[2], k.shape[2]
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((*q.shape[:3], 1), _NEG_BIG, jnp.float32)
+    kv = (k, v)
+    for s in range(n):
+        src = jnp.mod(rank - s, n)
+        o_s, lse_s = _partial_attn_xla(q, kv[0], kv[1], rank * sq, src * sk,
+                                       causal, scale)
+        o, lse = _combine(o, lse, o_s, lse_s)
+        if s != n - 1:
+            kv = _shift(kv, axis)
+    return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = AXIS_CONTEXT,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    impl: str = "auto",
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis``.
+
+    Call inside shard_map with q/k/v of per-device shape
+    ``(batch, heads, local_seq, head_dim)``, sharded along dim 2. Returns the
+    local shard of the attention output. Causal masking is exact across
+    shards (global positions = rank * local_seq + offset).
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = (d ** -0.5) if scale is None else float(scale)
+    if _resolve_impl(impl) == "xla" or not _supported(sq, sk, d):
+        return _ring_xla(q, k, v, axis, causal, scale)
+    blk_q = _pick_block(sq, block_q)
+    blk_k = _pick_block(sk, block_k)
+    return _ring(q, k, v, axis, bool(causal), scale, blk_q, blk_k)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = AXIS_CONTEXT,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Resharding (b, h, s/n, d) → (b, h/n, s, d) over ``axis``, full flash
+    attention on the assembled sequence, then the inverse reshard. Requires
+    ``heads % axis_size == 0``. Differentiable by construction.
+    """
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    n = lax.axis_size(axis)
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({q.shape[1]}) divisible by the "
+            f"'{axis}' axis size ({n})"
+        )
+    qg, kg, vg = (
+        lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+        for x in (q, k, v)
+    )
+    o = flash_attention(qg, kg, vg, causal=causal, scale=scale, impl=impl)
+    return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)

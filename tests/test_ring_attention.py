@@ -1,0 +1,113 @@
+"""Sequence-parallel attention: ring + Ulysses vs full attention.
+
+Pattern: serial-vs-sharded equivalence on a real-collective virtual CPU mesh
+(SURVEY.md §4 — the TPU analog of the reference's serial-vs-parallel layer
+tests, tests/L0/run_transformer/run_layers_test.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.ops.flash_attention import mha_reference
+from apex_tpu.transformer.ring import ring_attention, ulysses_attention
+
+CP = 4
+B, H, S, D = 2, 4, 128, 16  # 32 tokens per shard
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:CP]), ("context",))
+
+
+def _qkv(key, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, S, D), dtype)
+    k = jax.random.normal(kk, (B, H, S, D), dtype)
+    v = jax.random.normal(kv, (B, H, S, D), dtype)
+    return q, k, v
+
+
+def _sharded(mesh, fn):
+    spec = P(None, None, "context", None)
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
+def test_ring_forward_matches_full(mesh, causal, impl):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    fn = _sharded(mesh, lambda a, b_, c: ring_attention(
+        a, b_, c, causal=causal, impl=impl, block_q=16, block_k=16))
+    got = fn(q, k, v)
+    want = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_grads_match_full(mesh, causal):
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+    cot = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D))
+
+    ring = _sharded(mesh, lambda a, b_, c: ring_attention(
+        a, b_, c, causal=causal, impl="pallas", block_q=16, block_k=16))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) * cot)
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(mesh, causal):
+    q, k, v = _qkv(jax.random.PRNGKey(3))
+    fn = _sharded(mesh, lambda a, b_, c: ulysses_attention(a, b_, c, causal=causal))
+    got = fn(q, k, v)
+    want = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_grads(mesh):
+    q, k, v = _qkv(jax.random.PRNGKey(4))
+    cot = jax.random.normal(jax.random.PRNGKey(5), (B, H, S, D))
+    fn = _sharded(mesh, lambda a, b_, c: ulysses_attention(a, b_, c, causal=True))
+    got = jax.grad(lambda *xs: jnp.sum(fn(*xs) * cot), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(
+        lambda *xs: jnp.sum(mha_reference(*xs, causal=True) * cot),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
+
+
+def test_ring_rejects_nothing_on_odd_shapes(mesh):
+    # Shapes outside the Pallas envelope (seq not 8-aligned) fall back to the
+    # XLA ring and still match (the fused_softmax.py:151-171 fallback pattern).
+    b, h, s, d = 1, 2, 4 * 9, 8
+    kq = jax.random.PRNGKey(6)
+    q = jax.random.normal(kq, (b, h, s, d))
+    spec = P(None, None, "context", None)
+    fn = jax.jit(jax.shard_map(
+        lambda a, b_, c: ring_attention(a, b_, c, causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+    got = fn(q, q, q)
+    want = mha_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
