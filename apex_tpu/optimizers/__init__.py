@@ -19,3 +19,11 @@ from apex_tpu.optimizers.fused_sgd import fused_sgd, FusedSGD  # noqa: F401
 from apex_tpu.optimizers.fused_novograd import fused_novograd, FusedNovoGrad  # noqa: F401
 from apex_tpu.optimizers.fused_adagrad import fused_adagrad, FusedAdagrad  # noqa: F401
 from apex_tpu.optimizers.larc import larc, LARC  # noqa: F401
+from apex_tpu.optimizers.distributed import (  # noqa: F401
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    DistributedFusedSGD,
+    abstract_state,
+    distributed_fused,
+    state_specs,
+)

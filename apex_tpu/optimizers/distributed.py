@@ -1,0 +1,273 @@
+"""ZeRO-style distributed optimizers: reduce-scatter → sharded update → all-gather.
+
+Reference: apex/contrib/optimizers/distributed_fused_adam.py:55-477 and
+distributed_fused_lamb.py — flattened params split into blocks/chunks/shards,
+backward-hook-driven overlapped reduce-scatter pipelines on dedicated process
+groups, a sharded Adam/LAMB step over each rank's shard, then an all-gather of
+updated params (``_pipeline_block_reductions`` :397-441, ``_pipeline_step``
+:443-477).
+
+TPU-native design: all of the reference's machinery — hooks, block/chunk
+bookkeeping, dedicated reduce-scatter/all-reduce process groups, stream
+pipelining — exists to overlap communication with eager-mode backward. Under
+XLA, overlap is the latency-hiding scheduler's job; what remains is the ZeRO
+*math*, which is three collectives:
+
+    grads  --psum_scatter(axis)-->  grad shard        (1/n of every leaf)
+    shard  --inner optimizer   -->  update shard      (opt state is 1/n too)
+    update --all_gather(axis)  -->  full update tree
+
+``distributed_fused`` wraps ANY fused transform (FusedAdam, FusedSGD, …) this
+way; per-leaf chunks are 1-D slices of the flattened leaf, padded to the axis
+size. LAMB needs its per-tensor trust-ratio norms summed across shards, so
+``fused_lamb`` grows a ``norm_psum_axis`` and ``DistributedFusedLAMB`` passes
+it through. The e5m2-compressed allgather option (:64) is deliberately
+dropped — bf16 params already halve gather bytes and XLA has no sub-byte
+float collectives.
+
+Usage (inside shard_map over the ``data`` axis — grads enter *unreduced*,
+the scatter IS the gradient reduction, like the reference's hook-driven
+reduce-scatter replaces DDP allreduce):
+
+    tx = distributed_fused(fused_adam(lr=1e-3))
+    state = tx.init(params)                       # holds 1/n of the moments
+    updates, state = tx.update(grads, state, params)
+    params = optax.apply_updates(params, updates)
+
+Out-specs for the optimizer state under shard_map: ``state_specs(state,
+axis)`` (moment leaves are sharded on the axis; the step scalar replicated).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.optimizers._common import ClassOptimizer
+from apex_tpu.optimizers.fused_adam import fused_adam
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+from apex_tpu.optimizers.fused_sgd import fused_sgd
+from apex_tpu.parallel.mesh import AXIS_DATA
+
+
+def _padded_size(n_elems: int, n_shards: int) -> int:
+    return ((n_elems + n_shards - 1) // n_shards) * n_shards
+
+
+def _local_chunk(x: jax.Array, n: int, idx) -> jax.Array:
+    """This shard's 1-D chunk of a leaf (flatten → zero-pad → slice)."""
+    flat = x.reshape(-1)
+    padded = _padded_size(flat.size, n)
+    if padded != flat.size:
+        flat = jnp.pad(flat, (0, padded - flat.size))
+    k = padded // n
+    return lax.dynamic_slice(flat, (idx * k,), (k,))
+
+
+def _scatter_chunk(x: jax.Array, n: int, axis: str) -> jax.Array:
+    """Reduce-scatter a full (replica-partial) leaf into this rank's chunk."""
+    flat = x.reshape(-1)
+    padded = _padded_size(flat.size, n)
+    if padded != flat.size:
+        flat = jnp.pad(flat, (0, padded - flat.size))
+    return lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True)
+
+
+def _gather_leaf(chunk: jax.Array, shape, dtype, axis: str) -> jax.Array:
+    """All-gather chunks back into the full leaf shape."""
+    full = lax.all_gather(chunk, axis, axis=0, tiled=True)
+    n_elems = 1
+    for s in shape:
+        n_elems *= s
+    return full[:n_elems].reshape(shape).astype(dtype)
+
+
+def distributed_fused(
+    inner: optax.GradientTransformation,
+    axis: str = AXIS_DATA,
+    *,
+    grad_average: bool = True,
+) -> optax.GradientTransformation:
+    """Wrap a fused transform with ZeRO sharding over a mesh axis.
+
+    Must run inside shard_map binding ``axis``. ``update`` expects the
+    *unreduced* per-replica gradient tree (the psum_scatter performs the
+    data-parallel reduction, like the reference's reduce-scatter pipeline
+    subsumes DDP allreduce); ``grad_average=True`` divides by the axis size
+    (gradient averaging, distributed_fused_adam.py predivide semantics).
+    """
+
+    def init_fn(params):
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        chunks = jax.tree.map(
+            lambda p: _local_chunk(p.astype(jnp.float32), n, idx), params
+        )
+        return inner.init(chunks)
+
+    def update_fn(grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError("distributed_fused requires params")
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        g_chunks = jax.tree.map(
+            lambda g: _scatter_chunk(g.astype(jnp.float32), n, axis)
+            / (n if grad_average else 1),
+            grads,
+        )
+        p_chunks = jax.tree.map(
+            lambda p: _local_chunk(p.astype(jnp.float32), n, idx), params
+        )
+        upd_chunks, new_state = inner.update(g_chunks, state, p_chunks, **extra)
+        updates = jax.tree.map(
+            lambda u, p: _gather_leaf(u, p.shape, p.dtype, axis),
+            upd_chunks,
+            params,
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def state_specs(state: Any, axis: str = AXIS_DATA) -> Any:
+    """shard_map out-specs for a distributed_fused state: array leaves are
+    sharded on ``axis``, scalars (step counters) replicated."""
+    return jax.tree.map(
+        lambda x: P(axis) if getattr(x, "ndim", 0) >= 1 else P(), state
+    )
+
+
+def abstract_state(
+    inner: optax.GradientTransformation, params: Any, n_shards: int
+) -> Any:
+    """ShapeDtypeStruct pytree of a ``distributed_fused(inner)`` state as seen
+    per device — for building shard_map out_specs (with ``state_specs``)
+    without binding the mesh axis."""
+
+    def fake_init(p):
+        chunks = jax.tree.map(
+            lambda x: jnp.zeros(
+                (_padded_size(x.size, n_shards) // n_shards,), jnp.float32
+            ),
+            p,
+        )
+        return inner.init(chunks)
+
+    return jax.eval_shape(fake_init, params)
+
+
+class DistributedFusedAdam(ClassOptimizer):
+    """ZeRO-sharded FusedAdam (distributed_fused_adam.py:55-477 equivalent).
+
+    The reference's dwu_num_blocks/chunks/rs_pg/ar_pg overlap knobs have no
+    TPU meaning (XLA schedules the collectives); the optimizer math and the
+    1/n state memory footprint are preserved.
+    """
+
+    def __init__(
+        self,
+        lr=1e-3,
+        bias_correction=True,
+        betas=(0.9, 0.999),
+        eps=1e-8,
+        adam_w_mode=True,
+        weight_decay=0.0,
+        axis: str = AXIS_DATA,
+        grad_average: bool = True,
+        **_ignored,
+    ):
+        super().__init__(
+            distributed_fused(
+                fused_adam(
+                    lr=lr,
+                    betas=betas,
+                    eps=eps,
+                    weight_decay=weight_decay,
+                    adam_w_mode=adam_w_mode,
+                    bias_correction=bias_correction,
+                ),
+                axis=axis,
+                grad_average=grad_average,
+            ),
+            lr=lr,
+        )
+
+
+class DistributedFusedLAMB(ClassOptimizer):
+    """ZeRO-sharded FusedLAMB (distributed_fused_lamb.py equivalent).
+
+    Per-tensor trust-ratio norms and the global grad norm are psum'd over the
+    shard axis (the reference's inter-rank L2-norm allreduce,
+    distributed_fused_lamb.py `_pipeline_step` norm phase).
+    """
+
+    def __init__(
+        self,
+        lr=1e-3,
+        bias_correction=True,
+        betas=(0.9, 0.999),
+        eps=1e-6,
+        weight_decay=0.01,
+        grad_averaging=True,
+        adam_w_mode=True,
+        max_grad_norm=1.0,
+        use_nvlamb=False,
+        axis: str = AXIS_DATA,
+        grad_average: bool = True,
+        **_ignored,
+    ):
+        super().__init__(
+            distributed_fused(
+                fused_lamb(
+                    lr=lr,
+                    betas=betas,
+                    eps=eps,
+                    weight_decay=weight_decay,
+                    bias_correction=bias_correction,
+                    grad_averaging=grad_averaging,
+                    adam_w_mode=adam_w_mode,
+                    max_grad_norm=max_grad_norm,
+                    use_nvlamb=use_nvlamb,
+                    norm_psum_axis=axis,
+                ),
+                axis=axis,
+                grad_average=grad_average,
+            ),
+            lr=lr,
+        )
+
+
+class DistributedFusedSGD(ClassOptimizer):
+    """ZeRO-sharded FusedSGD (momentum state sharded 1/n)."""
+
+    def __init__(
+        self,
+        lr=1e-3,
+        momentum=0.0,
+        dampening=0.0,
+        weight_decay=0.0,
+        nesterov=False,
+        axis: str = AXIS_DATA,
+        grad_average: bool = True,
+        **_ignored,
+    ):
+        super().__init__(
+            distributed_fused(
+                fused_sgd(
+                    lr=lr,
+                    momentum=momentum,
+                    dampening=dampening,
+                    weight_decay=weight_decay,
+                    nesterov=nesterov,
+                ),
+                axis=axis,
+                grad_average=grad_average,
+            ),
+            lr=lr,
+        )

@@ -41,8 +41,19 @@ def fused_lamb(
     adam_w_mode: bool = True,
     max_grad_norm: float = 1.0,
     use_nvlamb: bool = False,
+    norm_psum_axis: str = None,
 ) -> optax.GradientTransformation:
+    """``norm_psum_axis``: when each leaf is a shard of the true tensor (ZeRO,
+    apex_tpu.optimizers.distributed), per-tensor and global norms must sum
+    squared partials across that mesh axis — the reference's inter-rank norm
+    allreduce in DistributedFusedLAMB."""
     beta1, beta2 = betas
+
+    def _sumsq(x):
+        s = jnp.sum(jnp.square(x))
+        if norm_psum_axis is not None:
+            s = jax.lax.psum(s, norm_psum_axis)
+        return s
     if not adam_w_mode:
         raise RuntimeError("FusedLAMB only supports adam_w_mode (decoupled wd), as the reference kernel does.")
 
@@ -66,7 +77,14 @@ def fused_lamb(
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
         # Phase 1: global grad norm + clip factor (fused_lamb.py:108-136).
-        global_norm = tree_l2norm(grads)
+        if norm_psum_axis is not None:
+            leaves = [g for g in jax.tree.leaves(grads)]
+            global_norm = jnp.sqrt(
+                sum(_sumsq(g.astype(jnp.float32)) for g in leaves)
+                if leaves else jnp.asarray(0.0, jnp.float32)
+            )
+        else:
+            global_norm = tree_l2norm(grads)
         if max_grad_norm and max_grad_norm > 0:
             clip = jnp.maximum(1.0, global_norm / max_grad_norm)
         else:
@@ -81,8 +99,8 @@ def fused_lamb(
             if weight_decay != 0.0:
                 upd = upd + weight_decay * p32
             # Per-tensor trust ratio (multi_tensor_lamb.cu stage 2).
-            w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
-            u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+            w_norm = jnp.sqrt(_sumsq(p32))
+            u_norm = jnp.sqrt(_sumsq(upd))
             ratio = jnp.where(
                 (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.asarray(1.0, jnp.float32)
             )
