@@ -40,8 +40,7 @@ axis)`` (moment leaves are sharded on the axis; the step scalar replicated).
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -60,32 +59,40 @@ def _padded_size(n_elems: int, n_shards: int) -> int:
     return ((n_elems + n_shards - 1) // n_shards) * n_shards
 
 
-def _local_chunk(x: jax.Array, n: int, idx) -> jax.Array:
-    """This shard's 1-D chunk of a leaf (flatten → zero-pad → slice)."""
+def _flat_padded(x: jax.Array, n: int) -> jax.Array:
+    """Flatten and zero-pad to a multiple of ``n`` — the one place defining
+    the chunk layout that slice and scatter must agree on."""
     flat = x.reshape(-1)
     padded = _padded_size(flat.size, n)
     if padded != flat.size:
         flat = jnp.pad(flat, (0, padded - flat.size))
-    k = padded // n
+    return flat
+
+
+def _local_chunk(x: jax.Array, n: int, idx) -> jax.Array:
+    """This shard's 1-D chunk of a leaf (flatten → zero-pad → slice)."""
+    flat = _flat_padded(x, n)
+    k = flat.size // n
     return lax.dynamic_slice(flat, (idx * k,), (k,))
 
 
 def _scatter_chunk(x: jax.Array, n: int, axis: str) -> jax.Array:
     """Reduce-scatter a full (replica-partial) leaf into this rank's chunk."""
-    flat = x.reshape(-1)
-    padded = _padded_size(flat.size, n)
-    if padded != flat.size:
-        flat = jnp.pad(flat, (0, padded - flat.size))
-    return lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True)
+    return lax.psum_scatter(
+        _flat_padded(x, n), axis, scatter_dimension=0, tiled=True
+    )
 
 
 def _gather_leaf(chunk: jax.Array, shape, dtype, axis: str) -> jax.Array:
-    """All-gather chunks back into the full leaf shape."""
-    full = lax.all_gather(chunk, axis, axis=0, tiled=True)
+    """All-gather chunks back into the full leaf shape. The chunk is cast to
+    the param dtype *before* the collective so a bf16 gather moves half the
+    bytes (the role of the reference's e5m2-compressed allgather option,
+    distributed_fused_adam.py:64)."""
+    full = lax.all_gather(chunk.astype(dtype), axis, axis=0, tiled=True)
     n_elems = 1
     for s in shape:
         n_elems *= s
-    return full[:n_elems].reshape(shape).astype(dtype)
+    return full[:n_elems].reshape(shape)
 
 
 def distributed_fused(

@@ -27,3 +27,9 @@ from apex_tpu.parallel.mesh import (  # noqa: F401
     set_virtual_pipeline_model_parallel_rank,
 )
 from apex_tpu.parallel import collectives  # noqa: F401
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+    sync_batch_norm,
+    sync_moments,
+)

@@ -1,0 +1,184 @@
+"""SyncBatchNorm: sync-vs-local equivalence on the virtual CPU mesh.
+
+Models tests/distributed/synced_batchnorm/ (python vs fused vs
+torch.nn.BatchNorm on 1-2 GPUs, fp16, uneven batch, group_size<world) as
+single-process shard_map tests: the sharded SyncBatchNorm over the 'data'
+axis must match a plain BatchNorm over the full (gathered) batch, in both
+forward values and input/param gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, convert_syncbn_model
+from flax import linen as nn
+
+
+@pytest.fixture
+def mesh():
+    m = parallel.initialize_model_parallel()  # 8-way data parallel
+    yield m
+    parallel.destroy_model_parallel()
+
+
+def _reference_bn(x, weight, bias, eps, c_ax):
+    dims = tuple(d for d in range(x.ndim) if d != c_ax)
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(dims)
+    var = x32.var(dims)
+    shape = [1] * x.ndim
+    shape[c_ax] = x.shape[c_ax]
+    y = (x32 - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    return y * weight.reshape(shape) + bias.reshape(shape)
+
+
+@pytest.mark.parametrize("channel_last", [False, True])
+def test_forward_matches_full_batch_bn(mesh, channel_last):
+    rng = np.random.default_rng(0)
+    c_ax = -1 if channel_last else 1
+    x = jnp.asarray(rng.normal(size=(16, 6, 5, 7)).astype(np.float32))
+    if channel_last:
+        x = jnp.moveaxis(x, 1, -1)  # NHWC
+
+    bn = SyncBatchNorm(axis_name="data", channel_last=channel_last)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    # distinctive affine params
+    nf = x.shape[c_ax]
+    w = jnp.asarray(rng.normal(size=(nf,)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(nf,)).astype(np.float32))
+    variables = {"params": {"scale": w, "bias": b}, "batch_stats": variables["batch_stats"]}
+
+    def body(v, xs):
+        y, updates = bn.apply(v, xs, mutable=["batch_stats"])
+        return y, updates
+
+    y, updates = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P("data"), P()),
+        check_vma=False,
+    )(variables, x)
+
+    expected = _reference_bn(x, w, b, 1e-5, c_ax if c_ax >= 0 else x.ndim - 1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected), atol=1e-5)
+    # running stats updated with global batch stats (momentum 0.1)
+    dims = tuple(d for d in range(x.ndim) if d != (c_ax % x.ndim))
+    gmean = np.asarray(x, np.float32).mean(dims)
+    n = x.size // x.shape[c_ax]
+    gvar = np.asarray(x, np.float32).var(dims) * n / (n - 1)
+    np.testing.assert_allclose(
+        np.asarray(updates["batch_stats"]["mean"]), 0.1 * gmean, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(updates["batch_stats"]["var"]), 0.9 * 1.0 + 0.1 * gvar, atol=1e-4
+    )
+
+
+def test_gradients_match_full_batch_bn(mesh):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(16, 4, 3)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(4,)).astype(np.float32))
+    b = jnp.zeros((4,), jnp.float32)
+    bn = SyncBatchNorm(axis_name="data", track_running_stats=False)
+    cot = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
+
+    def sharded_grads(params, xs, cots):
+        def loss(p, xv):
+            y = bn.apply({"params": p}, xv)
+            return jnp.sum(y * cots)
+
+        g_p, g_x = jax.grad(loss, argnums=(0, 1))(params, xs)
+        # replicated-param grads: each shard holds its local contribution
+        # (plus the cross-shard moment path via the psum transpose); the
+        # global grad is the psum — the DDP reduction step.
+        return jax.lax.psum(g_p, "data"), g_x
+
+    grads = jax.shard_map(
+        sharded_grads,
+        mesh=mesh,
+        in_specs=(P(), P("data"), P("data")),
+        out_specs=(P(), P("data")),
+        check_vma=False,
+    )(dict(scale=w, bias=b), x, cot)
+
+    def full_loss(params, xs):
+        y = _reference_bn(xs, params["scale"], params["bias"], 1e-5, 1)
+        return jnp.sum(y * cot)
+
+    ref = jax.grad(full_loss, argnums=(0, 1))(dict(scale=w, bias=b), x)
+    np.testing.assert_allclose(np.asarray(grads[0]["scale"]), np.asarray(ref[0]["scale"]), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(grads[0]["bias"]), np.asarray(ref[0]["bias"]), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(grads[1]), np.asarray(ref[1]), atol=2e-4)
+
+
+def test_group_size_subsets_axis(mesh):
+    """group_size=4 -> two independent groups of 4 shards
+    (create_syncbn_process_group equivalent)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32))
+    bn = SyncBatchNorm(axis_name="data", group_size=4, track_running_stats=False)
+    v = bn.init(jax.random.PRNGKey(0), x[:1])
+
+    y = jax.shard_map(
+        lambda xs: bn.apply(v, xs),
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=P("data"),
+        check_vma=False,
+    )(x)
+
+    w = jnp.ones((3,)); b = jnp.zeros((3,))
+    for half in (slice(0, 4), slice(4, 8)):
+        expected = _reference_bn(x[half], w, b, 1e-5, 1)
+        np.testing.assert_allclose(np.asarray(y[half]), np.asarray(expected), atol=1e-5)
+
+
+def test_eval_mode_uses_running_stats():
+    x = jnp.ones((4, 3)) * 2.0
+    bn = SyncBatchNorm()
+    v = bn.init(jax.random.PRNGKey(0), x)
+    stats = {"mean": jnp.full((3,), 1.0), "var": jnp.full((3,), 4.0),
+             "num_batches_tracked": jnp.ones((), jnp.int32)}
+    y = bn.apply({"params": v["params"], "batch_stats": stats}, x,
+                 use_running_average=True)
+    np.testing.assert_allclose(np.asarray(y), (2.0 - 1.0) / np.sqrt(4.0 + 1e-5), atol=1e-6)
+
+
+def test_half_input_fp32_stats_and_fuse_relu():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32)).astype(jnp.bfloat16)
+    bn = SyncBatchNorm(fuse_relu=True, track_running_stats=False)
+    v = bn.init(jax.random.PRNGKey(0), x)
+    y = bn.apply(v, x)
+    assert y.dtype == jnp.bfloat16
+    assert (np.asarray(y, np.float32) >= 0).all()
+
+
+def test_momentum_none_cumulative_average():
+    bn = SyncBatchNorm(momentum=None)
+    x1 = jnp.ones((4, 2)) * 1.0
+    x2 = jnp.ones((4, 2)) * 3.0
+    v = bn.init(jax.random.PRNGKey(0), x1)
+    _, v1 = bn.apply(v, x1, mutable=["batch_stats"])
+    v = {"params": v["params"], **v1}
+    _, v2 = bn.apply(v, x2, mutable=["batch_stats"])
+    # cumulative mean of batch means [1, 3] -> 2
+    np.testing.assert_allclose(np.asarray(v2["batch_stats"]["mean"]), 2.0, atol=1e-6)
+
+
+def test_convert_syncbn_model():
+    class Net(nn.Module):
+        bn: nn.Module
+
+        def __call__(self, x):
+            return self.bn(x)
+
+    net = Net(bn=SyncBatchNorm())
+    conv = convert_syncbn_model(net, axis_name="data", group_size=2)
+    assert conv.bn.axis_name == "data"
+    assert conv.bn.group_size == 2
