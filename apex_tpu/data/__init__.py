@@ -1,0 +1,9 @@
+"""Host-side data pipeline (reference: apex/transformer/_data + DALI-style
+loaders in examples/imagenet/main_amp.py:183-254).
+
+The reference's imagenet example feeds the GPU from DALI/torchvision loaders;
+this package is the TPU-native host-side counterpart: thread-prefetched batch
+streaming that keeps the chip fed while the current step runs.
+"""
+
+from apex_tpu.data.loader import NpyBatchLoader, PrefetchIterator  # noqa: F401

@@ -8,3 +8,11 @@ vehicles, the role standalone_gpt.py plays for the reference test suite.
 from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: F401
 from apex_tpu.models.mlp import MLP  # noqa: F401
 from apex_tpu.models.fused_dense import FusedDense, FusedDenseGeluDense  # noqa: F401
+from apex_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)

@@ -1,0 +1,159 @@
+"""ResNet (v1.5) — the framework's convnet example/benchmark vehicle.
+
+Reference: ``examples/imagenet/main_amp.py`` builds its models from
+``torchvision.models`` (main_amp.py:160-166) and trains them with amp O0-O3 +
+apex DDP + optional ``convert_syncbn_model``; the L1 convergence suite sweeps
+ResNet-50 across the full opt-level cross-product (tests/L1/common/run_test.sh:
+30-80). The reference repo therefore needs a vendored ResNet only implicitly —
+this module is the TPU-native equivalent of that torchvision dependency, so the
+imagenet recipe (BASELINE.md configs 1-2) is self-contained.
+
+TPU-first choices:
+
+- **NHWC layout** (channel-last): the native TPU convolution layout — the
+  reference gets this only through its experimental ``--channels-last`` flag
+  (main_amp.py:31,168-177) and the NHWC groupbn extension.
+- Normalization is **pluggable** via ``norm``: plain local BN by default, or
+  :class:`apex_tpu.parallel.SyncBatchNorm` over a mesh axis by passing
+  ``axis_name`` (the role of ``convert_syncbn_model``, main_amp.py:180-182).
+  conv→bn→relu chains use ``fuse_relu`` so the whole pattern is one fused XLA
+  region (the groupbn BN+ReLU fusion, apex/contrib/groupbn/batch_norm.py).
+- Compute dtype is a parameter; amp's ``cast_params`` keeps the ``bn*``
+  parameters fp32 under O2's ``keep_batchnorm_fp32`` because the layer names
+  carry the ``bn`` marker (precision.cast_params).
+- v1.5 stride placement: stride-2 lives on the 3x3 conv of the bottleneck
+  (torchvision semantics), the variant the reference's imagenet recipe trains.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+ModuleDef = Callable[..., nn.Module]
+
+# Standalone-block default: local BN, NHWC. ResNet overrides this with its
+# own (possibly axis-synced) factory.
+_default_norm = partial(SyncBatchNorm, channel_last=True)
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    norm: ModuleDef = _default_norm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, name=None)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=self.strides, padding=1, name="conv1")(x)
+        y = self.norm(fuse_relu=True, name="bn1")(y, use_running_average)
+        y = conv(self.filters, (3, 3), padding=1, name="conv2")(y)
+        y = self.norm(name="bn2")(y, use_running_average)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=self.strides, name="conv_ds")(x)
+            residual = self.norm(name="bn_ds")(residual, use_running_average)
+        return jax.nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3 (stride here: v1.5) → 1x1 residual block (ResNet-50+)."""
+
+    filters: int
+    strides: int = 1
+    norm: ModuleDef = _default_norm
+    dtype: Any = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        out = self.filters * self.expansion
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(fuse_relu=True, name="bn1")(y, use_running_average)
+        y = conv(self.filters, (3, 3), strides=self.strides, padding=1, name="conv2")(y)
+        y = self.norm(fuse_relu=True, name="bn2")(y, use_running_average)
+        y = conv(out, (1, 1), name="conv3")(y)
+        y = self.norm(name="bn3")(y, use_running_average)
+        if residual.shape != y.shape:
+            residual = conv(out, (1, 1), strides=self.strides, name="conv_ds")(x)
+            residual = self.norm(name="bn_ds")(residual, use_running_average)
+        return jax.nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet over NHWC inputs; ``__call__(images) -> logits``.
+
+    ``axis_name`` turns every BN into a SyncBatchNorm over that mesh axis
+    (with optional ``bn_group_size`` sub-grouping, the
+    ``create_syncbn_process_group`` knob). ``norm_cls`` swaps the norm
+    implementation wholesale (it must accept SyncBatchNorm's constructor
+    surface: ``momentum``/``axis_name``/``group_size``/``channel_last`` and
+    a ``fuse_relu`` flag).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    width: int = 64
+    axis_name: Optional[str] = None
+    bn_group_size: Optional[int] = None
+    norm_cls: ModuleDef = SyncBatchNorm
+    dtype: Any = jnp.float32
+    stem_pool: bool = True  # False for cifar-sized inputs in tests
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        norm = partial(
+            self.norm_cls,
+            momentum=0.1,
+            axis_name=self.axis_name,
+            group_size=self.bn_group_size,
+            channel_last=True,
+        )
+        x = x.astype(self.dtype)
+        if self.stem_pool:
+            x = nn.Conv(self.width, (7, 7), strides=2, padding=3, use_bias=False,
+                        dtype=self.dtype, name="conv1")(x)
+        else:
+            x = nn.Conv(self.width, (3, 3), padding=1, use_bias=False,
+                        dtype=self.dtype, name="conv1")(x)
+        x = norm(fuse_relu=True, name="bn1")(x, use_running_average)
+        if self.stem_pool:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = self.block_cls(
+                    filters=self.width * 2**i,
+                    strides=strides,
+                    norm=norm,
+                    dtype=self.dtype,
+                    name=f"layer{i + 1}_{j}",
+                )(x, use_running_average)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        # classifier in fp32 (amp keeps the last matmul's logits fp32-safe:
+        # functional_overrides FP32 list treats losses/softmax as fp32).
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x.astype(jnp.float32))
+        return x
+
+
+def _resnet(stage_sizes, block_cls, **kw) -> ResNet:
+    return ResNet(stage_sizes=stage_sizes, block_cls=block_cls, **kw)
+
+
+ResNet18 = partial(_resnet, (2, 2, 2, 2), BasicBlock)
+ResNet34 = partial(_resnet, (3, 4, 6, 3), BasicBlock)
+ResNet50 = partial(_resnet, (3, 4, 6, 3), Bottleneck)
+ResNet101 = partial(_resnet, (3, 4, 23, 3), Bottleneck)
+ResNet152 = partial(_resnet, (3, 8, 36, 3), Bottleneck)

@@ -1,0 +1,44 @@
+"""Host-side data pipeline tests (loader + prefetch)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.data import NpyBatchLoader, PrefetchIterator
+
+
+def test_prefetch_iterator_order_and_exhaustion():
+    it = PrefetchIterator(iter(range(10)), depth=3)
+    assert list(it) == list(range(10))
+
+
+def test_prefetch_iterator_propagates_errors():
+    def gen():
+        yield 1
+        raise ValueError("boom")
+
+    it = PrefetchIterator(gen(), depth=1)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="boom"):
+        next(it)
+
+
+def test_npy_batch_loader_rebatches_across_files(tmp_path):
+    rng = np.random.default_rng(0)
+    all_x, all_y = [], []
+    for i, n in enumerate([5, 3, 8]):  # uneven file sizes
+        x = rng.standard_normal((n, 4, 4, 3)).astype(np.float32)
+        y = rng.integers(0, 10, (n,))
+        np.savez(tmp_path / f"batch_{i}.npz", images=x, labels=y)
+        all_x.append(x)
+        all_y.append(y)
+    cat_x, cat_y = np.concatenate(all_x), np.concatenate(all_y)
+
+    loader = NpyBatchLoader(str(tmp_path), batch_shape=(4, 4, 4, 3))
+    batches = list(loader)
+    assert len(batches) == 4  # 16 samples / 4
+    got_x = np.concatenate([b[0] for b in batches])
+    got_y = np.concatenate([b[1] for b in batches])
+    np.testing.assert_array_equal(got_x, cat_x)
+    np.testing.assert_array_equal(got_y, cat_y)
+    for x, y in batches:
+        assert x.shape == (4, 4, 4, 3) and y.shape == (4,)
