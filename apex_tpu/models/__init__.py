@@ -5,6 +5,7 @@ These are the framework's example applications *and* its benchmark/test
 vehicles, the role standalone_gpt.py plays for the reference test suite.
 """
 
+from apex_tpu.models.bert import BertConfig, BertModel  # noqa: F401
 from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: F401
 from apex_tpu.models.mlp import MLP  # noqa: F401
 from apex_tpu.models.fused_dense import FusedDense, FusedDenseGeluDense  # noqa: F401

@@ -1,0 +1,185 @@
+"""Shared transformer backbone for the model zoo (GPT, BERT).
+
+The reference's standalone_gpt.py and standalone_bert.py share Megatron's
+ParallelMLP/ParallelAttention/ParallelTransformer internals; here the shared
+plumbing lives in :class:`TransformerBase` and the models keep only their own
+semantics (pre-LN causal LM vs post-LN masked LM, heads, losses).
+
+Both models use the same per-layer parameter tree
+``{ln1, ln2, qkv, proj, fc1, fc2}`` stacked on a leading ``num_layers`` dim
+and driven by ``lax.scan`` (compile time O(1) in depth, natural pipeline-stage
+slicing); only ``_layer`` — where LN sits relative to the residual — differs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.layer_norm import layer_norm as fused_layer_norm_op
+from apex_tpu.transformer import tensor_parallel as tp
+
+Params = Dict[str, Any]
+
+
+def stack_specs(spec_tree):
+    """Prefix each PartitionSpec with the stacked (num_layers) dim."""
+    return jax.tree.map(
+        lambda s: P(None, *s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+class TransformerBase:
+    """TP-sharded transformer plumbing shared by the model zoo.
+
+    Subclasses define ``causal`` and ``_layer(p, h, key, bias)``, and their
+    own ``init``/``specs``/``embed``/``head``. The config must provide the
+    common fields (hidden_size, num_attention_heads, num_layers, ffn, axis,
+    params_dtype, compute_dtype, hidden_dropout, init_method_std, remat,
+    attention_impl, vocab_size).
+    """
+
+    causal: bool = True
+
+    def __init__(self, config):
+        self.cfg = c = config
+        if c.hidden_size % c.num_attention_heads:
+            raise ValueError("hidden_size must divide evenly into heads")
+        init = tp.scaled_normal(c.init_method_std)
+        # Megatron scales output-layer init by 1/sqrt(2L)
+        # (standalone_gpt.py scaled_init_method_normal).
+        out_init = tp.scaled_normal(c.init_method_std / (2 * c.num_layers) ** 0.5)
+        self._init = init
+        self.embedding = tp.VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, axis=c.axis,
+            params_dtype=c.params_dtype, init_method=init,
+        )
+        self.qkv = tp.ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, axis=c.axis, gather_output=False,
+            params_dtype=c.params_dtype, init_method=init,
+        )
+        self.proj = tp.RowParallelLinear(
+            c.hidden_size, c.hidden_size, axis=c.axis, input_is_parallel=True,
+            params_dtype=c.params_dtype, init_method=out_init,
+        )
+        self.fc1 = tp.ColumnParallelLinear(
+            c.hidden_size, c.ffn, axis=c.axis, gather_output=False,
+            params_dtype=c.params_dtype, init_method=init,
+        )
+        self.fc2 = tp.RowParallelLinear(
+            c.ffn, c.hidden_size, axis=c.axis, input_is_parallel=True,
+            params_dtype=c.params_dtype, init_method=out_init,
+        )
+
+    # -- parameter helpers --------------------------------------------------
+
+    def _ln_init(self) -> Params:
+        c = self.cfg
+        return {
+            "scale": jnp.ones((c.hidden_size,), c.params_dtype),
+            "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+        }
+
+    def _dense_init(self, key, n_in, n_out) -> Params:
+        c = self.cfg
+        return {
+            "kernel": self._init(key, (n_in, n_out), c.params_dtype),
+            "bias": jnp.zeros((n_out,), c.params_dtype),
+        }
+
+    def _layer_init(self, k) -> Params:
+        ks = jax.random.split(k, 4)
+        return {
+            "ln1": self._ln_init(),
+            "qkv": self.qkv.init(ks[0]),
+            "proj": self.proj.init(ks[1]),
+            "ln2": self._ln_init(),
+            "fc1": self.fc1.init(ks[2]),
+            "fc2": self.fc2.init(ks[3]),
+        }
+
+    def init_layer_stack(self, key) -> Params:
+        """Stack per-layer trees along a leading num_layers dim (vmap over
+        init is the cleanest way to build the scan-shaped stack)."""
+        return jax.vmap(self._layer_init)(
+            jax.random.split(key, self.cfg.num_layers))
+
+    def layer_stack_specs(self) -> Params:
+        ln = {"scale": P(), "bias": P()}
+        return {
+            "ln1": stack_specs(ln),
+            "qkv": stack_specs(self.qkv.specs()),
+            "proj": stack_specs(self.proj.specs()),
+            "ln2": stack_specs(ln),
+            "fc1": stack_specs(self.fc1.specs()),
+            "fc2": stack_specs(self.fc2.specs()),
+        }
+
+    # -- compute helpers ----------------------------------------------------
+
+    def _ln(self, p: Params, x: jax.Array) -> jax.Array:
+        # Mixed-dtype fused LN: bf16 activations, fp32 γβ
+        # (MixedFusedLayerNorm, fused_layer_norm.py:398-436).
+        return fused_layer_norm_op(x, p["scale"], p["bias"])
+
+    def _dense(self, p: Params, x: jax.Array) -> jax.Array:
+        return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+    def _dropout(self, x, key, rank_unique: bool = False):
+        c = self.cfg
+        if key is None or c.hidden_dropout == 0.0:
+            return x
+        if rank_unique and c.axis is not None:
+            key = tp.model_parallel_key(key, c.axis)
+        keep = 1.0 - c.hidden_dropout
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def _attention(self, p: Params, h: jax.Array, bias=None) -> jax.Array:
+        c = self.cfg
+        b, s, _ = h.shape
+        qkv = self.qkv.apply(p["qkv"], h)  # (b, s, 3*H/tp)
+        # (heads, 3, head_dim) layout: a TP shard holds whole heads — the
+        # layout contract of ParallelAttention (standalone_gpt.py:560-640).
+        n_local = qkv.shape[-1] // (3 * c.head_dim)
+        qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
+        attn = flash_attention(q, k, v, bias=bias, causal=self.causal,
+                               impl=c.attention_impl)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
+        return self.proj.apply(p["proj"], attn)
+
+    def _mlp(self, p: Params, h: jax.Array) -> jax.Array:
+        return self.fc2.apply(p["fc2"], jax.nn.gelu(self.fc1.apply(p["fc1"], h)))
+
+    def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
+        raise NotImplementedError
+
+    def run_layers(
+        self,
+        layers: Params,
+        h: jax.Array,
+        attn_bias: Optional[jax.Array] = None,
+        dropout_key: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Scan the (stacked) layer params over the hidden state. ``layers``
+        may be any contiguous slice of the stack — a pipeline stage's chunk.
+        Activation checkpointing is ``jax.checkpoint`` on the scanned body
+        (reference: tensor_parallel/random.py:224-294 CheckpointFunction)."""
+        n = jax.tree.leaves(layers)[0].shape[0]
+        keys = None if dropout_key is None else jax.random.split(dropout_key, n)
+
+        def body(h, xs):
+            p, k = xs
+            return self._layer(p, h, k, attn_bias), None
+
+        if self.cfg.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        h, _ = lax.scan(body, h, (layers, keys))
+        return h

@@ -38,8 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from apex_tpu.ops.flash_attention import flash_attention
-from apex_tpu.ops.layer_norm import layer_norm as fused_layer_norm_op
+from apex_tpu.models._transformer import TransformerBase
 from apex_tpu.parallel.mesh import AXIS_MODEL
 from apex_tpu.transformer import tensor_parallel as tp
 
@@ -73,7 +72,7 @@ class GPTConfig:
         return self.hidden_size // self.num_attention_heads
 
 
-class GPTModel:
+class GPTModel(TransformerBase):
     """Functional GPT with TP-sharded params (GPTModel, standalone_gpt.py:1361+).
 
     ``init(key)`` → full param tree; ``specs()`` → PartitionSpec tree;
@@ -82,46 +81,12 @@ class GPTModel:
     ``head`` expose the stage boundaries pipeline schedules need (the
     functional replacement for the reference's pre_process/post_process
     flags and set_input_tensor, pipeline_parallel/schedules/common.py:24-112).
+    Shared transformer plumbing lives in TransformerBase (models/_transformer).
     """
 
-    def __init__(self, config: GPTConfig):
-        self.cfg = config
-        c = config
-        if c.hidden_size % c.num_attention_heads:
-            raise ValueError("hidden_size must divide evenly into heads")
-        init = tp.scaled_normal(c.init_method_std)
-        # Megatron scales output-layer init by 1/sqrt(2L)
-        # (standalone_gpt.py scaled_init_method_normal).
-        out_init = tp.scaled_normal(c.init_method_std / (2 * c.num_layers) ** 0.5)
-        self.embedding = tp.VocabParallelEmbedding(
-            c.vocab_size, c.hidden_size, axis=c.axis,
-            params_dtype=c.params_dtype, init_method=init,
-        )
-        self.qkv = tp.ColumnParallelLinear(
-            c.hidden_size, 3 * c.hidden_size, axis=c.axis, gather_output=False,
-            params_dtype=c.params_dtype, init_method=init,
-        )
-        self.proj = tp.RowParallelLinear(
-            c.hidden_size, c.hidden_size, axis=c.axis, input_is_parallel=True,
-            params_dtype=c.params_dtype, init_method=out_init,
-        )
-        self.fc1 = tp.ColumnParallelLinear(
-            c.hidden_size, c.ffn, axis=c.axis, gather_output=False,
-            params_dtype=c.params_dtype, init_method=init,
-        )
-        self.fc2 = tp.RowParallelLinear(
-            c.ffn, c.hidden_size, axis=c.axis, input_is_parallel=True,
-            params_dtype=c.params_dtype, init_method=out_init,
-        )
+    causal = True
 
     # -- parameters ---------------------------------------------------------
-
-    def _ln_init(self) -> Params:
-        c = self.cfg
-        return {
-            "scale": jnp.ones((c.hidden_size,), c.params_dtype),
-            "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
-        }
 
     def init(self, key: jax.Array) -> Params:
         c = self.cfg
@@ -129,58 +94,22 @@ class GPTModel:
         pos = tp.scaled_normal(c.init_method_std)(
             keys[1], (c.max_seq_len, c.hidden_size), c.params_dtype
         )
-
-        def layer_params(k) -> Params:
-            ks = jax.random.split(k, 4)
-            return {
-                "ln1": self._ln_init(),
-                "qkv": self.qkv.init(ks[0]),
-                "proj": self.proj.init(ks[1]),
-                "ln2": self._ln_init(),
-                "fc1": self.fc1.init(ks[2]),
-                "fc2": self.fc2.init(ks[3]),
-            }
-
-        layer_keys = jax.random.split(keys[2], c.num_layers)
-        # Stack per-layer trees along a leading num_layers dim (vmap over
-        # init is the cleanest way to build the scan-shaped stack).
-        layers = jax.vmap(layer_params)(layer_keys)
         return {
             "embedding": self.embedding.init(keys[0]),
             "position": pos,
-            "layers": layers,
+            "layers": self.init_layer_stack(keys[2]),
             "ln_f": self._ln_init(),
         }
 
     def specs(self) -> Params:
-        ln = {"scale": P(), "bias": P()}
-
-        def stack(spec_tree):
-            return jax.tree.map(
-                lambda s: P(None, *s), spec_tree,
-                is_leaf=lambda x: isinstance(x, P),
-            )
-
         return {
             "embedding": self.embedding.specs(),
             "position": P(),
-            "layers": {
-                "ln1": stack(ln),
-                "qkv": stack(self.qkv.specs()),
-                "proj": stack(self.proj.specs()),
-                "ln2": stack(ln),
-                "fc1": stack(self.fc1.specs()),
-                "fc2": stack(self.fc2.specs()),
-            },
-            "ln_f": ln,
+            "layers": self.layer_stack_specs(),
+            "ln_f": {"scale": P(), "bias": P()},
         }
 
     # -- stages -------------------------------------------------------------
-
-    def _ln(self, p: Params, x: jax.Array) -> jax.Array:
-        # Mixed-dtype fused LN: bf16 activations, fp32 γβ
-        # (MixedFusedLayerNorm, fused_layer_norm.py:398-436).
-        return fused_layer_norm_op(x, p["scale"], p["bias"])
 
     def embed(self, params: Params, tokens: jax.Array) -> jax.Array:
         c = self.cfg
@@ -188,53 +117,13 @@ class GPTModel:
         pos = params["position"][: tokens.shape[-1]]
         return (h + pos).astype(c.compute_dtype)
 
-    def _attention(self, p: Params, h: jax.Array) -> jax.Array:
-        c = self.cfg
-        b, s, _ = h.shape
-        qkv = self.qkv.apply(p["qkv"], h)  # (b, s, 3*H/tp)
-        n_local = qkv.shape[-1] // (3 * c.head_dim)
-        qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
-        attn = flash_attention(q, k, v, causal=True, impl=c.attention_impl)
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
-        return self.proj.apply(p["proj"], attn)
-
-    def _mlp(self, p: Params, h: jax.Array) -> jax.Array:
-        return self.fc2.apply(p["fc2"], jax.nn.gelu(self.fc1.apply(p["fc1"], h)))
-
-    def _dropout(self, x, key, rank_unique: bool):
-        c = self.cfg
-        if key is None or c.hidden_dropout == 0.0:
-            return x
-        if rank_unique and c.axis is not None:
-            key = tp.model_parallel_key(key, c.axis)
-        keep = 1.0 - c.hidden_dropout
-        mask = jax.random.bernoulli(key, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
-
-    def _layer(self, p: Params, h: jax.Array, key) -> jax.Array:
+    def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
+        """Pre-LN block: residual + sublayer(LN(h))."""
         k1, k2 = (None, None) if key is None else tuple(jax.random.split(key))
         # Post-residual dropout is replicated across TP ranks (same key);
         # the reference draws it from the default (data-parallel) RNG state.
-        h = h + self._dropout(self._attention(p, self._ln(p["ln1"], h)), k1, False)
-        h = h + self._dropout(self._mlp(p, self._ln(p["ln2"], h)), k2, False)
-        return h
-
-    def run_layers(
-        self, layers: Params, h: jax.Array, dropout_key: Optional[jax.Array] = None
-    ) -> jax.Array:
-        """Scan the (stacked) layer params over the hidden state. ``layers``
-        may be any contiguous slice of the stack — a pipeline stage's chunk."""
-        n = jax.tree.leaves(layers)[0].shape[0]
-        keys = None if dropout_key is None else jax.random.split(dropout_key, n)
-
-        def body(h, xs):
-            p, k = xs
-            return self._layer(p, h, k), None
-
-        if self.cfg.remat:
-            body = jax.checkpoint(body, prevent_cse=False)
-        h, _ = lax.scan(body, h, (layers, keys))
+        h = h + self._dropout(self._attention(p, self._ln(p["ln1"], h), bias), k1)
+        h = h + self._dropout(self._mlp(p, self._ln(p["ln2"], h)), k2)
         return h
 
     def head(
@@ -261,7 +150,7 @@ class GPTModel:
         dropout_key: Optional[jax.Array] = None,
     ):
         h = self.embed(params, tokens)
-        h = self.run_layers(params["layers"], h, dropout_key)
+        h = self.run_layers(params["layers"], h, dropout_key=dropout_key)
         return self.head(params, h, targets)
 
     def loss(self, params, tokens, targets, dropout_key=None) -> jax.Array:

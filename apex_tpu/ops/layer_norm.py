@@ -102,9 +102,9 @@ def _ln_bwd_kernel(
     # kernel) — the two-pass part reduction of layer_norm_cuda_kernel.cu's
     # cuComputePartGradGammaBeta.
     if dw_ref is not None:
-        dw_ref[...] = jnp.sum(g * xhat, axis=0, keepdims=True)
+        dw_ref[...] = jnp.sum(g * xhat, axis=0).reshape(dw_ref.shape)
     if db_ref is not None:
-        db_ref[...] = jnp.sum(g, axis=0, keepdims=True)
+        db_ref[...] = jnp.sum(g, axis=0).reshape(db_ref.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -179,7 +179,14 @@ def _bwd_pallas(g2d, x2d, mean, rstd, w, *, rms, has_w, has_b):
     row_spec = pl.BlockSpec((blk, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM)
     stat_spec = pl.BlockSpec((blk, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
     vec_spec = pl.BlockSpec((hidden,), lambda i: (0,), memory_space=pltpu.VMEM)
-    part_spec = pl.BlockSpec((1, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    # Per-grid-step partial γ/β sums. Mosaic requires a block's trailing two
+    # dims to be 8/128-divisible or equal to the array's; a (1, hidden) block
+    # over (grid, hidden) violates the sublane rule, so the partials are
+    # (grid, 1, hidden) with the grid axis leading and the block covering the
+    # trailing (1, hidden) exactly.
+    part_spec = pl.BlockSpec(
+        (1, 1, hidden), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+    )
 
     in_specs = [row_spec, row_spec, stat_spec, stat_spec]
     args = [g2d, x2d, mean, rstd]
@@ -191,10 +198,10 @@ def _bwd_pallas(g2d, x2d, mean, rstd, w, *, rms, has_w, has_b):
     out_shape = [jax.ShapeDtypeStruct(x2d.shape, x2d.dtype)]
     if has_w:
         out_specs.append(part_spec)
-        out_shape.append(jax.ShapeDtypeStruct((grid, hidden), jnp.float32))
+        out_shape.append(jax.ShapeDtypeStruct((grid, 1, hidden), jnp.float32))
     if has_b:
         out_specs.append(part_spec)
-        out_shape.append(jax.ShapeDtypeStruct((grid, hidden), jnp.float32))
+        out_shape.append(jax.ShapeDtypeStruct((grid, 1, hidden), jnp.float32))
 
     def kernel(*refs):
         w_ref = refs[4] if has_w else None
@@ -218,10 +225,10 @@ def _bwd_pallas(g2d, x2d, mean, rstd, w, *, rms, has_w, has_b):
     i = 1
     dw = db = None
     if has_w:
-        dw = jnp.sum(outs[i], axis=0)
+        dw = jnp.sum(outs[i], axis=(0, 1))
         i += 1
     if has_b:
-        db = jnp.sum(outs[i], axis=0)
+        db = jnp.sum(outs[i], axis=(0, 1))
     return dx, dw, db
 
 

@@ -73,15 +73,19 @@ def build(policy_level: str, impl: str):
 def measure(train_step, params, opt_state, batch, seq, steps=10) -> float:
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 50304)
     targets = jnp.roll(tokens, -1, axis=-1)
-    # warmup / compile
+    # warmup / compile. Through remote-device tunnels (axon),
+    # block_until_ready can ack dispatch rather than execution, so force a
+    # device->host transfer of a value that depends on the whole chain.
     params, opt_state, loss, _ = train_step(params, opt_state, tokens, targets)
-    jax.block_until_ready(params)
+    float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss, _ = train_step(params, opt_state, tokens, targets)
-    jax.block_until_ready(params)
+    # the final loss depends on every prior step's params: fetching it to the
+    # host forces full execution before the clock stops.
+    loss_val = float(loss)
     dt = (time.perf_counter() - t0) / steps
-    assert jnp.isfinite(loss), "non-finite loss in bench"
+    assert jnp.isfinite(loss_val), "non-finite loss in bench"
     return batch * seq / dt
 
 

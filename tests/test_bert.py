@@ -1,0 +1,145 @@
+"""BERT model tests (BASELINE.md config 3: BERT + FusedLAMB + fused LN).
+
+Reference patterns: run_bert_minimal_test.py (BERT runs, loss sane, trains)
+and serial-vs-TP-sharded equivalence (run_layers_test.py style).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models import BertConfig, BertModel
+from apex_tpu.optimizers import FusedLAMB
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer import tensor_parallel as tp
+
+TINY = dict(
+    vocab_size=64,
+    hidden_size=32,
+    num_layers=2,
+    num_attention_heads=4,
+    max_seq_len=16,
+    hidden_dropout=0.0,
+    compute_dtype=jnp.float32,
+    remat=False,
+)
+
+
+def _batch(key, batch=4, seq=16, vocab=64):
+    ks = jax.random.split(key, 4)
+    toks = jax.random.randint(ks[0], (batch, seq), 0, vocab)
+    attn_mask = jnp.ones((batch, seq), jnp.int32).at[:, -3:].set(0)  # padding
+    loss_mask = (jax.random.uniform(ks[1], (batch, seq)) < 0.15).astype(jnp.int32)
+    labels = jax.random.randint(ks[2], (batch, seq), 0, vocab)
+    nsp = jax.random.randint(ks[3], (batch,), 0, 2)
+    return toks, attn_mask, loss_mask, labels, nsp
+
+
+def test_bert_forward_shapes_and_loss():
+    model = BertModel(BertConfig(axis=None, **TINY))
+    params = model.init(jax.random.PRNGKey(0))
+    toks, attn, lmask, labels, nsp = _batch(jax.random.PRNGKey(1))
+    logits, binary = model.apply(params, toks, attn)
+    assert logits.shape == (4, 16, 64)
+    assert binary.shape == (4, 2)
+    loss = model.loss(params, toks, attn, lmask, labels, nsp)
+    # ~ln(64)=4.16 MLM + ~ln(2)=0.69 NSP at init
+    assert 3.0 < float(loss) < 7.0
+
+
+def test_bert_padding_mask_matters():
+    """Attention must ignore padded keys: changing a masked-out token's
+    content must not change unmasked positions' logits."""
+    model = BertModel(BertConfig(axis=None, **TINY))
+    params = model.init(jax.random.PRNGKey(0))
+    toks, attn, *_ = _batch(jax.random.PRNGKey(1))
+    logits1, _ = model.apply(params, toks, attn)
+    toks2 = toks.at[:, -1].set((toks[:, -1] + 1) % 64)
+    logits2, _ = model.apply(params, toks2, attn)
+    # positions other than the changed (padded) one are identical
+    np.testing.assert_allclose(
+        np.asarray(logits1[:, :-3]), np.asarray(logits2[:, :-3]),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_bert_tp_matches_serial():
+    serial = BertModel(BertConfig(axis=None, **TINY))
+    par = BertModel(BertConfig(axis="model", **TINY))
+    params = serial.init(jax.random.PRNGKey(0))
+    toks, attn, lmask, labels, nsp = _batch(jax.random.PRNGKey(1))
+
+    mesh = mesh_lib.make_virtual_mesh(4, tensor_model_parallel_size=4)
+    try:
+        specs = par.specs()
+        sharded = tp.shard_params(params, specs, mesh)
+
+        def loss_fn(p, toks, attn, lmask, labels, nsp):
+            return par.loss(p, toks, attn, lmask, labels, nsp)
+
+        fn = jax.jit(jax.shard_map(
+            jax.value_and_grad(loss_fn), mesh=mesh,
+            in_specs=(specs, P(), P(), P(), P(), P()),
+            out_specs=(P(), specs), check_vma=False,
+        ))
+        v_p, g_p = fn(sharded, toks, attn, lmask, labels, nsp)
+        v_s, g_s = jax.value_and_grad(serial.loss)(
+            params, toks, attn, lmask, labels, nsp)
+        np.testing.assert_allclose(float(v_s), float(v_p), rtol=2e-5)
+        flat_s, _ = jax.tree_util.tree_flatten(g_s)
+        flat_p, _ = jax.tree_util.tree_flatten(jax.device_get(g_p))
+        for a, b in zip(flat_s, flat_p):
+            np.testing.assert_allclose(a, np.asarray(b), rtol=2e-4, atol=2e-4)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_bert_fused_lamb_o2_trains():
+    """The config-3 slice: bf16 O2 masters + FusedLAMB; loss must drop."""
+    cfg = dict(TINY)
+    cfg["compute_dtype"] = jnp.bfloat16
+    model = BertModel(BertConfig(axis=None, **cfg))
+    policy = amp.get_policy("O2")
+    mp_opt = amp.MixedPrecisionOptimizer(FusedLAMB(lr=2e-2), policy)
+    params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    opt_state = mp_opt.init(params)
+    toks, attn, lmask, labels, nsp = _batch(jax.random.PRNGKey(1))
+
+    @jax.jit
+    def step(p, s):
+        def scaled(p):
+            return mp_opt.scale_loss(
+                model.loss(p, toks, attn, lmask, labels, nsp), s)
+        ls, gs = jax.value_and_grad(scaled)(p)
+        np_, ns, metrics = mp_opt.apply_gradients(s, p, gs)
+        return np_, ns, ls / s.scaler.loss_scale, metrics
+
+    first = None
+    for _ in range(40):
+        params, opt_state, loss, metrics = step(params, opt_state)
+        first = first if first is not None else float(loss)
+    assert jnp.isfinite(loss)
+    assert float(loss) < first * 0.9
+    assert params["lm_dense"]["kernel"].dtype == jnp.bfloat16
+
+
+def test_bert_stage_decomposition_matches_apply():
+    from apex_tpu.models.bert import extended_attention_mask
+
+    model = BertModel(BertConfig(axis=None, **TINY))
+    params = model.init(jax.random.PRNGKey(0))
+    toks, attn, lmask, labels, nsp = _batch(jax.random.PRNGKey(1))
+    full_lm, full_bin = model.apply(params, toks, attn, masked_lm_labels=labels)
+    bias = extended_attention_mask(attn)
+    h = model.embed(params, toks)
+    sl0 = jax.tree.map(lambda x: x[:1], params["layers"])
+    sl1 = jax.tree.map(lambda x: x[1:], params["layers"])
+    h = model.run_layers(sl0, h, bias)
+    h = model.run_layers(sl1, h, bias)
+    staged_lm, staged_bin = model.head(params, h, labels)
+    np.testing.assert_allclose(np.asarray(full_lm), np.asarray(staged_lm),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(full_bin), np.asarray(staged_bin),
+                               rtol=1e-5, atol=1e-6)
