@@ -1,0 +1,18 @@
+"""Legacy manual mixed-precision API (reference: apex/fp16_utils/).
+
+The pre-amp surface the reference keeps for backward compatibility:
+``FP16_Optimizer`` (fp16_optimizer.py:13-551), static/dynamic ``LossScaler``
+(loss_scaler.py), and the conversion helpers (fp16util.py:35-175). New code
+should use ``apex_tpu.amp``; this package preserves the old names and
+semantics for users migrating reference scripts.
+"""
+
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+from apex_tpu.fp16_utils.fp16util import (  # noqa: F401
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+    tofp16,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler  # noqa: F401

@@ -1,0 +1,138 @@
+"""FP16_Optimizer — the legacy master-weight wrapper
+(reference: apex/fp16_utils/fp16_optimizer.py:13-551).
+
+The reference wraps a torch optimizer: it clones fp16 params into fp32
+masters, patches ``backward()`` to scale the loss, unscales grads into the
+masters, optionally clips them (``clip_master_grads``), steps in fp32, and
+copies masters back to the fp16 model params; dynamic loss scaling skips
+steps on overflow.
+
+Functional translation: the wrapper owns an inner ``ClassOptimizer``/optax
+transform; its state is ``(inner, master, scaler)``; ``step`` performs
+unscale → clip → ``lax.cond``-guarded update → master→model copy-out, and
+``state_dict``/``load_state_dict`` round-trip everything
+(fp16_optimizer.py:209-271).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp.scaler import LossScaler as _AmpScaler
+from apex_tpu.fp16_utils.fp16util import (
+    master_params_to_model_params,
+    prep_param_lists,
+)
+from apex_tpu.ops.multi_tensor import tree_clip_by_global_norm, tree_l2norm
+from apex_tpu.optimizers._common import ClassOptimizer
+
+
+class FP16OptState(NamedTuple):
+    inner: Any
+    master: Any
+    scaler: _AmpScaler
+
+
+class FP16_Optimizer:
+    """Drop-in legacy wrapper (fp16_optimizer.py:13-130 constructor surface:
+    ``static_loss_scale``, ``dynamic_loss_scale``, ``dynamic_loss_args``,
+    ``verbose`` is dropped).
+
+    >>> opt = FP16_Optimizer(FusedAdam(lr=1e-3), dynamic_loss_scale=True)
+    >>> state = opt.init(bf16_params)
+    >>> scaled = opt.scale_loss(loss, state)        # "backward(loss)"
+    >>> params, state, info = opt.step(state, params, scaled_grads,
+    ...                                max_norm=1.0)  # clip_master_grads
+    """
+
+    def __init__(
+        self,
+        optimizer: Union[optax.GradientTransformation, ClassOptimizer],
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        dynamic_loss_args: Optional[dict] = None,
+    ):
+        self.inner = (
+            optimizer.transform if isinstance(optimizer, ClassOptimizer) else optimizer
+        )
+        if dynamic_loss_scale:
+            # legacy defaults (loss_scaler.py:47+): init 2^32, window 1000
+            kwargs = dict(init_scale=2.0 ** 32, scale_window=1000)
+            kwargs.update(dynamic_loss_args or {})
+            self._mk_scaler = lambda: _AmpScaler.create(loss_scale="dynamic", **kwargs)
+        else:
+            self._mk_scaler = lambda: _AmpScaler.create(loss_scale=float(static_loss_scale))
+
+    def init(self, model_params) -> FP16OptState:
+        _, master = prep_param_lists(model_params)
+        return FP16OptState(
+            inner=self.inner.init(master),
+            master=master,
+            scaler=self._mk_scaler(),
+        )
+
+    def scale_loss(self, loss: jax.Array, state: FP16OptState) -> jax.Array:
+        """The ``optimizer.backward(loss)`` scaling half
+        (fp16_optimizer.py:326-388): scale the loss, let the caller autodiff."""
+        return state.scaler.scale(loss)
+
+    def clip_master_grads(self, grads32, max_norm: float) -> Tuple[Any, jax.Array]:
+        """Global-norm clip over the unscaled master grads
+        (``clip_master_grads``, fp16_optimizer.py:274-292). Returns
+        ``(clipped, total_norm)``."""
+        return tree_clip_by_global_norm(grads32, max_norm)
+
+    def step(
+        self,
+        state: FP16OptState,
+        model_params,
+        scaled_grads,
+        max_norm: Optional[float] = None,
+    ):
+        """unscale → (clip) → cond-guarded fp32 update → copy-out
+        (``step``, fp16_optimizer.py:294-324). Returns
+        ``(new_model_params, new_state, info)`` with
+        ``info = {overflow, loss_scale, grad_norm}``."""
+        grads32, found_inf = state.scaler.unscale(scaled_grads, out_dtype=jnp.float32)
+        if max_norm is not None:
+            grads32, grad_norm = self.clip_master_grads(grads32, max_norm)
+        else:
+            grad_norm = tree_l2norm(grads32)
+
+        def _do(operand):
+            master, inner = operand
+            updates, new_inner = self.inner.update(grads32, inner, master)
+            return optax.apply_updates(master, updates), new_inner
+
+        new_master, new_inner = jax.lax.cond(
+            found_inf, lambda o: o, _do, (state.master, state.inner)
+        )
+        new_model = master_params_to_model_params(new_master, model_params)
+        new_scaler = state.scaler.update(found_inf)
+        info = {
+            "overflow": found_inf,
+            "loss_scale": new_scaler.loss_scale,
+            "grad_norm": grad_norm,
+        }
+        return new_model, FP16OptState(new_inner, new_master, new_scaler), info
+
+    # -- checkpointing (fp16_optimizer.py:209-271) --------------------------
+    def state_dict(self, state: FP16OptState):
+        return {
+            "inner": state.inner,
+            "master": state.master,
+            "scaler": state.scaler.state_dict(),
+        }
+
+    def load_state_dict(self, state: FP16OptState, payload) -> FP16OptState:
+        """Restores masters/inner/scaler. Like the reference, the inner state
+        tree structure must match the wrapped optimizer's."""
+        return FP16OptState(
+            inner=jax.tree.map(lambda _, v: jnp.asarray(v), state.inner, payload["inner"]),
+            master=jax.tree.map(lambda _, v: jnp.asarray(v), state.master, payload["master"]),
+            scaler=state.scaler.load_state_dict(payload["scaler"]),
+        )

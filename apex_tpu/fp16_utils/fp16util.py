@@ -1,0 +1,66 @@
+"""Conversion helpers (reference: apex/fp16_utils/fp16util.py:35-175).
+
+The reference mutates torch modules in place (``network.half()``, master
+``Parameter`` clones); the functional equivalents transform pytrees and
+return new trees.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_float(a) -> bool:
+    return hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+
+
+def tofp16(params):
+    """Cast all floating leaves to fp16 (``tofp16``/``network.half()``,
+    fp16util.py:35-42). On TPU prefer bf16 via ``convert_network``."""
+    return jax.tree.map(
+        lambda a: a.astype(jnp.float16) if _is_float(a) else a, params
+    )
+
+
+def convert_network(params, dtype=jnp.bfloat16, keep_norms_fp32: bool = True):
+    """Cast a network's params, optionally keeping norm-layer params fp32
+    (``convert_network`` skips _BatchNorm modules, fp16util.py:44-58).
+
+    Norm detection is path-based like ``apex_tpu.precision.cast_params``."""
+    from apex_tpu.precision import _path_is_norm
+
+    def _cast(path, leaf):
+        if not _is_float(leaf):
+            return leaf
+        if keep_norms_fp32 and _path_is_norm(path):
+            return leaf.astype(jnp.float32)
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, params)
+
+
+def prep_param_lists(params):
+    """``(model_params, master_params)``: fp32 master copies of the model tree
+    (``prep_param_lists``, fp16util.py:100-126 — without the flatten option;
+    XLA fuses the update sweep regardless of memory layout)."""
+    master = jax.tree.map(
+        lambda a: a.astype(jnp.float32) if _is_float(a) else a, params
+    )
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads):
+    """Copy model (possibly half) grads into fp32 master grads
+    (fp16util.py:128-150)."""
+    return jax.tree.map(
+        lambda g: g.astype(jnp.float32) if _is_float(g) else g, model_grads
+    )
+
+
+def master_params_to_model_params(master_params, model_params):
+    """Cast updated masters back into the model dtypes (fp16util.py:152-175)."""
+    return jax.tree.map(
+        lambda m, p: m.astype(p.dtype) if _is_float(p) else m,
+        master_params, model_params,
+    )

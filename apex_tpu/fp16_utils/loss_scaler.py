@@ -1,0 +1,32 @@
+"""Static & dynamic loss scalers, legacy names
+(reference: apex/fp16_utils/loss_scaler.py:10-45,47+).
+
+Both are thin views over the amp ``LossScaler`` pytree so legacy code and amp
+code share one state machine. ``LossScaler`` here is the *static* scaler (the
+reference's class of the same name); ``DynamicLossScaler`` mirrors the
+2^16-init / x2-window-2000 / /2-on-overflow schedule.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.amp.scaler import LossScaler as _AmpScaler
+
+
+def LossScaler(scale: float = 1.0) -> _AmpScaler:
+    """Static scaler (loss_scaler.py:10-45): fixed ``scale``, never updates."""
+    return _AmpScaler.create(loss_scale=float(scale))
+
+
+def DynamicLossScaler(
+    init_scale: float = 2.0 ** 32,
+    scale_factor: float = 2.0,
+    scale_window: int = 1000,
+) -> _AmpScaler:
+    """Dynamic scaler with the legacy defaults (loss_scaler.py:47+:
+    init 2^32, window 1000 — *not* the amp defaults of 2^16/2000)."""
+    return _AmpScaler.create(
+        loss_scale="dynamic",
+        init_scale=init_scale,
+        scale_factor=scale_factor,
+        scale_window=scale_window,
+    )

@@ -12,10 +12,12 @@ sharding constraints under ``pjit``.
 - ``pipeline_parallel`` — 1F1B / interleaved schedules, microbatches
 - ``functional``        — fused scale-mask-softmax module
 - ``amp``               — model-parallel-aware grad scaler
+- ``data``              — pretraining batch samplers + microbatch slicing
 - ``ring``              — ring attention + Ulysses sequence parallelism over
                           the ``context`` axis (new vs the reference)
 """
 
+from apex_tpu.transformer import data  # noqa: F401
 from apex_tpu.transformer import parallel_state  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401

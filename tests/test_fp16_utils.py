@@ -1,0 +1,118 @@
+"""Legacy fp16_utils API tests (reference: tests/L0/run_fp16util/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    DynamicLossScaler,
+    LossScaler,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+)
+from apex_tpu.optimizers import FusedAdam
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "dense": {"kernel": jax.random.normal(k, (8, 8), jnp.bfloat16),
+                  "bias": jnp.zeros((8,), jnp.bfloat16)},
+        "bn": {"scale": jnp.ones((8,), jnp.float32)},
+        "step": jnp.zeros((), jnp.int32),  # non-float leaf survives untouched
+    }
+
+
+def test_convert_network_keeps_norms_fp32():
+    p = convert_network(
+        {"dense": {"kernel": jnp.zeros((2, 2), jnp.float32)},
+         "bn": {"scale": jnp.ones((2,), jnp.float32)}},
+        dtype=jnp.bfloat16)
+    assert p["dense"]["kernel"].dtype == jnp.bfloat16
+    assert p["bn"]["scale"].dtype == jnp.float32
+
+
+def test_prep_and_copy_helpers_roundtrip():
+    model = _params()
+    model2, master = prep_param_lists(model)
+    assert master["dense"]["kernel"].dtype == jnp.float32
+    assert master["step"].dtype == jnp.int32
+    g = jax.tree.map(
+        lambda a: jnp.ones_like(a) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        model)
+    g32 = model_grads_to_master_grads(g)
+    assert g32["dense"]["bias"].dtype == jnp.float32
+    back = master_params_to_model_params(master, model)
+    assert back["dense"]["kernel"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(back["dense"]["kernel"], np.float32),
+        np.asarray(model["dense"]["kernel"], np.float32))
+
+
+def test_legacy_scalers():
+    s = LossScaler(128.0)
+    assert float(s.loss_scale) == 128.0
+    assert not s.dynamic
+    d = DynamicLossScaler(init_scale=2.0 ** 8, scale_window=1)
+    assert d.dynamic
+    # overflow halves, a clean window doubles
+    d2 = d.update(jnp.asarray(True))
+    assert float(d2.loss_scale) == 2.0 ** 7
+    d3 = d2.update(jnp.asarray(False))
+    assert float(d3.loss_scale) == 2.0 ** 8
+
+
+def test_fp16_optimizer_step_and_overflow_skip():
+    model = {"w": jnp.ones((4,), jnp.bfloat16)}
+    opt = FP16_Optimizer(FusedAdam(lr=0.1), dynamic_loss_scale=True)
+    state = opt.init(model)
+    assert state.master["w"].dtype == jnp.float32
+
+    def loss_fn(p):
+        return jnp.sum(jnp.square(p["w"].astype(jnp.float32)))
+
+    @jax.jit
+    def train(p, s):
+        g = jax.grad(lambda q: opt.scale_loss(loss_fn(q), s))(p)
+        return opt.step(s, p, g, max_norm=10.0)
+
+    p1, s1, info = train(model, state)
+    assert not bool(info["overflow"])
+    assert float(jnp.abs(p1["w"].astype(jnp.float32) - 1.0).max()) > 0
+    assert p1["w"].dtype == jnp.bfloat16
+
+    # inf grads -> skip step, halve scale
+    bad = {"w": jnp.full((4,), jnp.inf, jnp.bfloat16)}
+    p2, s2, info2 = jax.jit(opt.step)(s1, p1, bad)
+    assert bool(info2["overflow"])
+    np.testing.assert_array_equal(np.asarray(p2["w"], np.float32),
+                                  np.asarray(p1["w"], np.float32))
+    assert float(s2.scaler.loss_scale) == float(s1.scaler.loss_scale) / 2
+
+
+def test_fp16_optimizer_clip_master_grads():
+    opt = FP16_Optimizer(FusedAdam(lr=0.1))
+    g = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 2.0)}
+    clipped, norm = opt.clip_master_grads(g, max_norm=1.0)
+    np.testing.assert_allclose(float(norm), np.sqrt(48 + 16), rtol=1e-6)
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(v)) for v in jax.tree.leaves(clipped)))
+    np.testing.assert_allclose(float(total), 1.0, rtol=1e-4)
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    model = {"w": jnp.ones((4,), jnp.bfloat16)}
+    opt = FP16_Optimizer(FusedAdam(lr=0.1), dynamic_loss_scale=True)
+    state = opt.init(model)
+    g = {"w": jnp.full((4,), 0.5, jnp.bfloat16) * state.scaler.loss_scale}
+    _, state1, _ = jax.jit(opt.step)(state, model, g)
+    payload = jax.device_get(opt.state_dict(state1))
+    fresh = opt.init(model)
+    restored = opt.load_state_dict(fresh, payload)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored.master, state1.master)
+    assert float(restored.scaler.loss_scale) == float(state1.scaler.loss_scale)
