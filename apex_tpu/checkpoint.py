@@ -1,0 +1,169 @@
+"""Checkpoint / resume (reference: SURVEY.md §5 "Checkpoint / resume").
+
+The reference's checkpoint story is three state dicts — model, optimizer, and
+``amp.state_dict()`` for the loss scaler (apex/amp/frontend.py:361-400,
+README.md:59-99) — plus ``FP16_Optimizer.state_dict`` for the legacy path
+(fp16_utils/fp16_optimizer.py:209-271). Here the whole train state (params,
+``MPOptState`` incl. fp32 masters and scaler, anything else) is one pytree,
+so a checkpoint is one atomic save of that tree.
+
+Design points (TPU-native):
+
+- **orbax** backend when available (async-capable, multi-host aware), with a
+  dependency-free ``.npz`` fallback so the module works anywhere;
+- **topology-independent**: arrays are saved as host numpy in the tree's
+  logical (unsharded) shapes; on restore the caller re-applies whatever
+  ``NamedSharding`` the *new* mesh prescribes (``restore(..., sharding_tree=)``)
+  — resume may change mesh shape (SURVEY.md §5 failure-detection note);
+- step-numbered directories with ``latest_step`` discovery, the
+  ``save_checkpoint``/``load_checkpoint`` UX of Megatron-style trainers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+try:  # pragma: no cover - exercised via the public API either way
+    import orbax.checkpoint as _ocp
+except Exception:  # noqa: BLE001 - any import failure selects the fallback
+    _ocp = None
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_SEP = "/"
+
+
+def _path_key(path) -> str:
+    parts = []
+    for p in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return _SEP.join(parts)
+
+
+_META_KEY = "__apex_tpu_dtypes__"
+
+
+def _flatten(tree) -> dict:
+    """Flatten to {path: ndarray}. Non-native dtypes (bfloat16, fp8 — numpy
+    would silently store them as raw void and break round-trips) are saved as
+    byte arrays with (dtype, shape) recorded under ``_META_KEY``."""
+    flat = {}
+    meta = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _path_key(path)
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == "V" or not arr.dtype.isbuiltin:
+            meta[key] = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
+            arr = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+        flat[key] = arr
+    flat[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8
+    )
+    return flat
+
+
+def _unflatten_into(target, flat: dict):
+    """Rebuild ``target``'s structure from the flat mapping (missing keys are
+    an error; dtype/shape come from the saved arrays)."""
+    meta = {}
+    if _META_KEY in flat:
+        meta = json.loads(bytes(np.asarray(flat[_META_KEY])).decode("utf-8"))
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(target)
+    new_leaves = []
+    for path, leaf in leaves_with_path:
+        key = _path_key(path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        arr = flat[key]
+        if key in meta:
+            import jax.numpy as jnp
+
+            dt = jnp.dtype(meta[key]["dtype"])
+            arr = np.asarray(arr).view(dt).reshape(meta[key]["shape"])
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step}")
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Largest saved step number, or None (the auto-resume discovery the
+    reference leaves as an unused slot, pipeline_parallel/utils.py:35)."""
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(m.group(1))
+        for name in os.listdir(directory)
+        if (m := _STEP_RE.match(name))
+    ]
+    return max(steps) if steps else None
+
+
+def save_checkpoint(directory: str, step: int, state: Any, *, backend: str = "auto") -> str:
+    """Save ``state`` (any pytree: params, MPOptState, FP16OptState, …) under
+    ``directory/step_{step}``. Returns the checkpoint path."""
+    use_orbax = _ocp is not None if backend == "auto" else backend == "orbax"
+    if use_orbax and _ocp is None:
+        raise RuntimeError("backend='orbax' requested but orbax is unavailable")
+    path = _step_dir(directory, step)
+    os.makedirs(directory, exist_ok=True)
+    host_state = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), state)
+    if use_orbax:
+        ckptr = _ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), host_state, force=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "state.npz"), **_flatten(host_state))
+    return path
+
+
+def restore_checkpoint(
+    directory: str,
+    target: Any,
+    step: Optional[int] = None,
+    *,
+    sharding_tree: Any = None,
+    backend: str = "auto",
+) -> Any:
+    """Restore the pytree saved at ``step`` (default: latest) into the
+    structure of ``target``.
+
+    ``sharding_tree``: optional pytree of ``jax.sharding.Sharding`` (same
+    structure, e.g. built from ``model.specs()`` and the *current* mesh) —
+    each restored leaf is ``device_put`` to its sharding, which is what makes
+    resume topology-independent."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _step_dir(directory, step)
+    npz = os.path.join(path, "state.npz")
+    if backend == "npz" or (backend == "auto" and os.path.exists(npz)):
+        with np.load(npz) as z:
+            restored = _unflatten_into(target, dict(z))
+    else:
+        if _ocp is None:
+            raise RuntimeError("orbax unavailable and no npz checkpoint found")
+        ckptr = _ocp.PyTreeCheckpointer()
+        host_target = jax.tree.map(
+            lambda a: _ocp.utils.to_shape_dtype_struct(a)
+            if hasattr(_ocp.utils, "to_shape_dtype_struct") else a,
+            target,
+        )
+        restored = ckptr.restore(os.path.abspath(path), item=host_target)
+    # re-cast non-float metadata exactly; reapply shardings if given
+    if sharding_tree is not None:
+        restored = jax.tree.map(jax.device_put, restored, sharding_tree)
+    return restored

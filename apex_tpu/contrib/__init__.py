@@ -1,0 +1,8 @@
+"""apex_tpu.contrib — TPU-native counterparts of apex/contrib.
+
+Implemented: multihead_attn (fused self/enc-dec MHA ± norm-add),
+xentropy + fmha live in apex_tpu.ops (flash_attention subsumes fmhalib;
+softmax_cross_entropy subsumes xentropy_cuda), sparsity (ASP 2:4),
+transducer; groupbn's NHWC BN maps to
+apex_tpu.parallel.SyncBatchNorm(channel_last=True).
+"""

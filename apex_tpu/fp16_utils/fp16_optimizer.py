@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.amp.scaler import LossScaler as _AmpScaler
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
 from apex_tpu.fp16_utils.fp16util import (
     master_params_to_model_params,
     prep_param_lists,
@@ -59,13 +60,12 @@ class FP16_Optimizer:
         self.inner = (
             optimizer.transform if isinstance(optimizer, ClassOptimizer) else optimizer
         )
+        self.dynamic = dynamic_loss_scale
         if dynamic_loss_scale:
-            # legacy defaults (loss_scaler.py:47+): init 2^32, window 1000
-            kwargs = dict(init_scale=2.0 ** 32, scale_window=1000)
-            kwargs.update(dynamic_loss_args or {})
-            self._mk_scaler = lambda: _AmpScaler.create(loss_scale="dynamic", **kwargs)
+            kwargs = dict(dynamic_loss_args or {})
+            self._mk_scaler = lambda: DynamicLossScaler(**kwargs)
         else:
-            self._mk_scaler = lambda: _AmpScaler.create(loss_scale=float(static_loss_scale))
+            self._mk_scaler = lambda: LossScaler(static_loss_scale)
 
     def init(self, model_params) -> FP16OptState:
         _, master = prep_param_lists(model_params)
@@ -108,9 +108,16 @@ class FP16_Optimizer:
             updates, new_inner = self.inner.update(grads32, inner, master)
             return optax.apply_updates(master, updates), new_inner
 
-        new_master, new_inner = jax.lax.cond(
-            found_inf, lambda o: o, _do, (state.master, state.inner)
-        )
+        if self.dynamic:
+            new_master, new_inner = jax.lax.cond(
+                found_inf, lambda o: o, _do, (state.master, state.inner)
+            )
+        else:
+            # legacy static scaler never skips: the step proceeds and any
+            # non-finites surface in the params (reference LossScaler has no
+            # overflow machinery, loss_scaler.py:10-45) — found_inf is still
+            # reported in info for callers that want to react.
+            new_master, new_inner = _do((state.master, state.inner))
         new_model = master_params_to_model_params(new_master, model_params)
         new_scaler = state.scaler.update(found_inf)
         info = {

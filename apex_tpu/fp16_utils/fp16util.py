@@ -27,17 +27,11 @@ def convert_network(params, dtype=jnp.bfloat16, keep_norms_fp32: bool = True):
     """Cast a network's params, optionally keeping norm-layer params fp32
     (``convert_network`` skips _BatchNorm modules, fp16util.py:44-58).
 
-    Norm detection is path-based like ``apex_tpu.precision.cast_params``."""
-    from apex_tpu.precision import _path_is_norm
+    Delegates to :func:`apex_tpu.precision.cast_floats` so norm detection has
+    a single home."""
+    from apex_tpu.precision import cast_floats
 
-    def _cast(path, leaf):
-        if not _is_float(leaf):
-            return leaf
-        if keep_norms_fp32 and _path_is_norm(path):
-            return leaf.astype(jnp.float32)
-        return leaf.astype(dtype)
-
-    return jax.tree_util.tree_map_with_path(_cast, params)
+    return cast_floats(params, dtype, keep_norms_fp32=keep_norms_fp32)
 
 
 def prep_param_lists(params):

@@ -2,9 +2,9 @@
 (reference: apex/fp16_utils/loss_scaler.py:10-45,47+).
 
 Both are thin views over the amp ``LossScaler`` pytree so legacy code and amp
-code share one state machine. ``LossScaler`` here is the *static* scaler (the
-reference's class of the same name); ``DynamicLossScaler`` mirrors the
-2^16-init / x2-window-2000 / /2-on-overflow schedule.
+code share one state machine, with the *legacy* defaults: the dynamic scaler
+starts at 2^32 with a growth window of 1000 and no growth cap (the legacy
+class has none — vs amp's 2^16 / 2000 / 2^24-cap defaults).
 """
 
 from __future__ import annotations
@@ -22,11 +22,12 @@ def DynamicLossScaler(
     scale_factor: float = 2.0,
     scale_window: int = 1000,
 ) -> _AmpScaler:
-    """Dynamic scaler with the legacy defaults (loss_scaler.py:47+:
-    init 2^32, window 1000 — *not* the amp defaults of 2^16/2000)."""
+    """Dynamic scaler with the legacy defaults (loss_scaler.py:47+)."""
     return _AmpScaler.create(
         loss_scale="dynamic",
         init_scale=init_scale,
         scale_factor=scale_factor,
         scale_window=scale_window,
+        # legacy scaler has no growth cap; never clamp below the init scale
+        max_loss_scale=float("inf"),
     )

@@ -256,6 +256,21 @@ def _path_is_norm(path) -> bool:
     return False
 
 
+def cast_floats(params, dtype, keep_norms_fp32: bool = True):
+    """Cast floating leaves to ``dtype``, keeping norm-path leaves fp32 when
+    asked — the shared engine behind ``cast_params`` and the legacy
+    ``fp16_utils.convert_network`` (fp16util.py:44-58)."""
+
+    def _cast(path, leaf):
+        if not _is_float_array(leaf):
+            return leaf
+        if keep_norms_fp32 and _path_is_norm(path):
+            return jnp.asarray(leaf, jnp.float32)
+        return jnp.asarray(leaf, dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, params)
+
+
 def cast_params(params, policy: Policy):
     """Cast a param pytree per policy (reference: _initialize.py:176-182).
 
@@ -267,15 +282,10 @@ def cast_params(params, policy: Policy):
     """
     if policy.cast_model_type is None:
         return params
-
-    def _cast(path, leaf):
-        if not _is_float_array(leaf):
-            return leaf
-        if policy.keep_batchnorm_fp32 and _path_is_norm(path):
-            return jnp.asarray(leaf, jnp.float32)
-        return jnp.asarray(leaf, policy.cast_model_type)
-
-    return jax.tree_util.tree_map_with_path(_cast, params)
+    return cast_floats(
+        params, policy.cast_model_type,
+        keep_norms_fp32=policy.keep_batchnorm_fp32,
+    )
 
 
 def _is_float_array(a) -> bool:

@@ -134,6 +134,8 @@ class MegatronPretrainingRandomSampler:
 def get_kth_microbatch(batch, k: int, num_microbatches: int):
     """Slice microbatch ``k`` out of a global batch pytree along dim 0
     (pipeline_parallel/utils.py:122+)."""
+    if not 0 <= k < num_microbatches:
+        raise ValueError(f"k={k} out of range for {num_microbatches} microbatches")
 
     def _slice(x):
         if x.shape[0] % num_microbatches:

@@ -94,6 +94,19 @@ def test_fp16_optimizer_step_and_overflow_skip():
     assert float(s2.scaler.loss_scale) == float(s1.scaler.loss_scale) / 2
 
 
+def test_fp16_optimizer_static_scale_never_skips():
+    """Legacy static LossScaler has no overflow machinery: the step proceeds
+    and non-finites surface in the params (loss_scaler.py:10-45)."""
+    model = {"w": jnp.ones((4,), jnp.bfloat16)}
+    opt = FP16_Optimizer(FusedAdam(lr=0.1), static_loss_scale=128.0)
+    state = opt.init(model)
+    bad = {"w": jnp.full((4,), jnp.inf, jnp.bfloat16)}
+    p, s, info = jax.jit(opt.step)(state, model, bad)
+    assert bool(info["overflow"])  # reported...
+    assert float(s.scaler.loss_scale) == 128.0  # ...but scale untouched
+    assert not np.all(np.asarray(p["w"], np.float32) == 1.0)  # step happened
+
+
 def test_fp16_optimizer_clip_master_grads():
     opt = FP16_Optimizer(FusedAdam(lr=0.1))
     g = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 2.0)}
