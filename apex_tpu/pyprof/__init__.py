@@ -1,0 +1,27 @@
+"""apex_tpu.pyprof — profiling (reference: apex/pyprof, 4 988 LoC).
+
+The reference's 3-stage pipeline (SURVEY.md §5) maps onto XLA-native
+facilities:
+
+1. ``nvtx/nvmarker.py`` monkey-patches every torch call to push NVTX ranges
+   → here, :func:`annotate` / :func:`scope` wrap ``jax.named_scope`` so op
+   provenance lands in HLO metadata and trace timelines — no monkey-patching,
+   tracing makes call sites explicit.
+2. nvprof SQLite parsing (``parse/``) → :func:`trace` wraps
+   ``jax.profiler.trace``; the TensorBoard/perfetto trace replaces the
+   nvprof database.
+3. per-kernel FLOP/byte analysis (``prof/``, 26 op-category files) →
+   :func:`cost_analysis` reads XLA's own compiled-program cost model
+   (flops/bytes per executable), and :func:`primitive_counts` gives the
+   per-op breakdown from the jaxpr. :func:`profile_fn` times a jitted fn
+   and reports achieved FLOP/s and bytes/s against those analytic counts.
+"""
+
+from apex_tpu.pyprof.prof import (  # noqa: F401
+    annotate,
+    cost_analysis,
+    primitive_counts,
+    profile_fn,
+    scope,
+    trace,
+)

@@ -1,0 +1,121 @@
+"""Profiling primitives: scopes, traces, cost analysis, throughput.
+
+Reference mapping is described in the package docstring. The FLOP accounting
+the reference computes per op family by hand (pyprof/prof/blas.py, conv.py,
+...) comes from XLA's cost model here — the compiler already knows.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from collections import Counter
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def scope(name: str):
+    """Named range for traces/HLO metadata (the NVTX ``range_push``/``pop``
+    pair, pyprof/nvtx/nvmarker.py). Use as a context manager."""
+    return jax.named_scope(name)
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator wrapping a function in a named scope
+    (``pyprof.nvtx.annotate`` equivalent)."""
+
+    def deco(fn):
+        label = name or getattr(fn, "__name__", "annotated")
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with jax.named_scope(label):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a profiler trace viewable in TensorBoard/perfetto (replaces
+    nvprof capture + pyprof/parse)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def _compiled_with_analysis(fn: Callable, *args, **kwargs):
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+        analysis = analysis[0]
+    return jitted, compiled, dict(analysis)
+
+
+def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    """XLA cost model for ``fn(*args)``: at least ``flops`` and
+    ``bytes accessed`` (the totals pyprof derives per kernel from shape
+    arithmetic, pyprof/prof/*.py)."""
+    return _compiled_with_analysis(fn, *args, **kwargs)[2]
+
+
+def primitive_counts(fn: Callable, *args, **kwargs) -> Dict[str, int]:
+    """Per-primitive op counts from the jaxpr — the op-category breakdown
+    (pyprof/prof's one-handler-per-family table) at trace level."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    counts: Counter = Counter()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            counts[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                if isinstance(v, jax.extend.core.ClosedJaxpr):
+                    walk(v.jaxpr)
+                elif isinstance(v, (list, tuple)):
+                    for item in v:
+                        if isinstance(item, jax.extend.core.ClosedJaxpr):
+                            walk(item.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return dict(counts)
+
+
+def profile_fn(
+    fn: Callable,
+    *args,
+    steps: int = 10,
+    **kwargs,
+) -> Dict[str, Any]:
+    """Time a jitted ``fn`` and combine wall clock with the XLA cost model:
+    returns ``{seconds_per_call, flops, achieved_flops_per_sec,
+    bytes_accessed, achieved_bytes_per_sec}`` — the per-op efficiency table
+    of pyprof/prof/output.py, collapsed to the program level."""
+    jitted, _, analysis = _compiled_with_analysis(fn, *args, **kwargs)
+    out = jitted(*args, **kwargs)  # warmup
+    np.asarray(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = jitted(*args, **kwargs)
+    # Force execution with ONE small host fetch after the loop: device ops
+    # execute in order, so fetching the last output waits for all steps
+    # (remote tunnels can ack block_until_ready at dispatch, and per-step
+    # fetches would bill transfer bandwidth to compute).
+    np.asarray(jax.tree.leaves(out)[0])
+    dt = (time.perf_counter() - t0) / steps
+    flops = float(analysis.get("flops", 0.0))
+    bytes_accessed = float(analysis.get("bytes accessed", 0.0))
+    return {
+        "seconds_per_call": dt,
+        "flops": flops,
+        "achieved_flops_per_sec": flops / dt if dt > 0 else 0.0,
+        "bytes_accessed": bytes_accessed,
+        "achieved_bytes_per_sec": bytes_accessed / dt if dt > 0 else 0.0,
+    }
