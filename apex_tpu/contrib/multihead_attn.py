@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from apex_tpu.ops.flash_attention import flash_attention, mha_reference
 from apex_tpu.ops.layer_norm import layer_norm as fused_layer_norm
+from apex_tpu.utils.nn import inverted_dropout as _dropout
 
 Params = Dict[str, Any]
 
@@ -43,13 +44,6 @@ def _xavier(key, shape, dtype, gain=1.0):
     std = gain * math.sqrt(2.0 / (fan_in + fan_out))
     return std * jax.random.normal(key, shape, dtype)
 
-
-def _dropout(x, key, rate):
-    if key is None or rate == 0.0:
-        return x
-    keep = 1.0 - rate
-    mask = jax.random.bernoulli(key, keep, x.shape)
-    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
 def _padding_bias(key_padding_mask) -> jax.Array:

@@ -1,0 +1,92 @@
+"""ASP — automatic 2:4 structured sparsity
+(reference: apex/contrib/sparsity/: sparse_masklib.py mask computation +
+asp.py model/optimizer instrumentation).
+
+The reference computes per-weight 2:4 masks (2 of every 4 contiguous
+elements along the input dim survive, chosen by magnitude), zeroes the
+weights, and patches ``optimizer.step`` to re-apply masks after each update
+(``ASP.init_optimizer_for_pruning``, asp.py:28-312). Functionally:
+
+    masks = compute_sparse_masks(params)            # once, after pretraining
+    params = apply_masks(params, masks)
+    ... inside train step, after the optimizer update:
+    params = apply_masks(params, masks)             # the patched-step re-mask
+
+The channel-permutation search (permutation_lib.py, 925 LoC + CUDA) that
+recovers accuracy for permuted channels is out of scope; masks here are the
+``m4n2_1d`` default pattern (sparse_masklib.py create_mask).
+
+On-TPU value: 2:4 is an NVIDIA Ampere hardware feature; TPUs have no sparse
+MXU mode, so the win here is algorithmic parity (sparse fine-tuning
+experiments port unchanged) — masked weights stay dense-shaped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def m4n2_mask_1d(w: jax.Array) -> jax.Array:
+    """2-of-4 magnitude mask along the last dim (sparse_masklib.py mn_1d_best
+    for m=4, n=2). Last dim must be divisible by 4."""
+    if w.shape[-1] % 4:
+        raise ValueError(f"last dim {w.shape[-1]} not divisible by 4")
+    groups = jnp.abs(w).reshape(*w.shape[:-1], -1, 4)
+    # rank within each group of 4; keep the top 2
+    order = jnp.argsort(groups, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = (ranks >= 2).reshape(w.shape)
+    return mask
+
+
+def _default_allow(path, leaf) -> bool:
+    """Prune 2-D+ weight leaves with input dim divisible by 4 (the reference
+    prunes Linear/Conv weights with shape constraints, asp.py:110-143)."""
+    return (
+        hasattr(leaf, "ndim")
+        and leaf.ndim >= 2
+        and leaf.shape[-1] % 4 == 0
+        and jnp.issubdtype(leaf.dtype, jnp.floating)
+    )
+
+
+def compute_sparse_masks(
+    params: Any,
+    allow: Optional[Callable] = None,
+) -> Any:
+    """Mask tree: 2:4 masks for prunable leaves, None elsewhere
+    (``ASP.compute_sparse_masks``, asp.py:178-230)."""
+    allow = allow or _default_allow
+
+    def _mask(path, leaf):
+        if allow(path, leaf):
+            return m4n2_mask_1d(leaf)
+        return None
+
+    return jax.tree_util.tree_map_with_path(_mask, params)
+
+
+def apply_masks(params: Any, masks: Any) -> Any:
+    """Zero masked weights (the patched ``step``'s re-mask, asp.py:246-262).
+    Call after every optimizer update to keep the pruned pattern."""
+
+    def _apply(p, m):
+        if m is None:
+            return p
+        return jnp.where(m, p, 0).astype(p.dtype)
+
+    return jax.tree.map(_apply, params, masks, is_leaf=lambda x: x is None)
+
+
+def sparsity_ratio(params: Any, masks: Any) -> float:
+    """Fraction of weights pruned across masked leaves (reporting helper)."""
+    masked = pruned = 0
+    for p, m in zip(jax.tree.leaves(params), jax.tree.leaves(masks, is_leaf=lambda x: x is None)):
+        if m is None:
+            continue
+        masked += m.size
+        pruned += int(m.size - jnp.sum(m))
+    return pruned / masked if masked else 0.0

@@ -95,17 +95,12 @@ void apex_unflatten(const void* src, void** dsts, const int64_t* sizes, int n,
 
 namespace {
 
-struct Slot {
-  std::vector<char> data;
-  bool full = false;
-};
-
 struct TokenLoader {
   std::vector<std::string> files;
   int64_t batch_bytes = 0;
   bool loop = false;
 
-  std::vector<Slot> ring;
+  std::vector<std::vector<char>> ring;
   size_t head = 0, tail = 0;  // consumer reads head, producer writes tail
   size_t count = 0;
   bool done = false;
@@ -139,8 +134,7 @@ struct TokenLoader {
                 std::fclose(f);
                 return;
               }
-              ring[tail].data.swap(carry);
-              ring[tail].full = true;
+              ring[tail].swap(carry);
               tail = (tail + 1) % ring.size();
               ++count;
               lk.unlock();
@@ -170,7 +164,7 @@ void* tl_create(const char** paths, int n_files, int64_t batch_bytes,
   tl->batch_bytes = batch_bytes;
   tl->loop = loop != 0;
   tl->ring.resize(n_buffers > 0 ? n_buffers : 2);
-  for (auto& s : tl->ring) s.data.reserve(batch_bytes);
+  for (auto& s : tl->ring) s.reserve(batch_bytes);
   tl->worker = std::thread(&TokenLoader::produce, tl);
   return tl;
 }
@@ -181,9 +175,8 @@ int tl_next(void* handle, void* out) {
   std::unique_lock<std::mutex> lk(tl->mu);
   tl->not_empty.wait(lk, [&] { return tl->count > 0 || tl->done; });
   if (tl->count == 0) return 0;
-  std::memcpy(out, tl->ring[tl->head].data.data(),
+  std::memcpy(out, tl->ring[tl->head].data(),
               static_cast<size_t>(tl->batch_bytes));
-  tl->ring[tl->head].full = false;
   tl->head = (tl->head + 1) % tl->ring.size();
   --tl->count;
   lk.unlock();

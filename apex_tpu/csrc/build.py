@@ -95,7 +95,7 @@ def flatten(arrays: Sequence[np.ndarray], threads: int = 4) -> np.ndarray:
 def unflatten(flat: np.ndarray, like: Sequence[np.ndarray], threads: int = 4) -> List[np.ndarray]:
     """Split a flat buffer back into arrays shaped/typed like ``like``
     (apex_C.unflatten, csrc/flatten_unflatten.cpp:16)."""
-    flat = np.ascontiguousarray(flat.view(np.uint8).reshape(-1))
+    flat = np.ascontiguousarray(flat).view(np.uint8).reshape(-1)
     total = sum(a.nbytes for a in like)
     if flat.nbytes != total:
         raise ValueError(f"flat buffer {flat.nbytes}B != templates {total}B")
@@ -134,39 +134,50 @@ class TokenLoader:
         self.batch_shape = tuple(batch_shape)
         self.dtype = np.dtype(dtype)
         self.batch_bytes = int(np.prod(self.batch_shape)) * self.dtype.itemsize
+        if self.batch_bytes <= 0:
+            raise ValueError(f"empty batch shape {self.batch_shape}")
         self.loop = loop
         self._lib = _get()
+        self._n_buffers = n_buffers
         self._handle = None
-        if self._lib is not None:
-            arr = (ctypes.c_char_p * len(self.paths))(
-                *[p.encode() for p in self.paths])
-            self._handle = self._lib.tl_create(
-                arr, len(self.paths), self.batch_bytes, n_buffers, int(loop))
+
+    def _create_handle(self):
+        arr = (ctypes.c_char_p * len(self.paths))(*[p.encode() for p in self.paths])
+        return self._lib.tl_create(
+            arr, len(self.paths), self.batch_bytes, self._n_buffers, int(self.loop))
 
     def __iter__(self):
-        if self._handle is not None:
+        """Each iteration restarts the stream, with either backend."""
+        if self._lib is not None:
             return self._native_iter()
         return self._python_iter()
 
     def _native_iter(self):
+        self.close()  # retire any previous stream
+        self._handle = self._create_handle()
         out = np.empty(self.batch_shape, self.dtype)
-        while True:
-            ok = self._lib.tl_next(self._handle, out.ctypes.data_as(ctypes.c_void_p))
-            if not ok:
-                return
-            yield out.copy()
+        try:
+            while True:
+                ok = self._lib.tl_next(self._handle, out.ctypes.data_as(ctypes.c_void_p))
+                if not ok:
+                    return
+                yield out.copy()
+        finally:
+            self.close()
 
     def _python_iter(self):
         carry = b""
         while True:
+            produced = 0  # fruitless-pass guard, mirrors the native backend
             for p in self.paths:
                 with open(p, "rb") as f:
                     while chunk := f.read(1 << 16):
+                        produced += len(chunk)
                         carry += chunk
                         while len(carry) >= self.batch_bytes:
                             buf, carry = carry[: self.batch_bytes], carry[self.batch_bytes :]
                             yield np.frombuffer(buf, self.dtype).reshape(self.batch_shape).copy()
-            if not self.loop:
+            if not self.loop or produced == 0:
                 return
 
     def close(self):

@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.ops.layer_norm import layer_norm as fused_layer_norm_op
 from apex_tpu.transformer import tensor_parallel as tp
+from apex_tpu.utils.nn import inverted_dropout
 
 Params = Dict[str, Any]
 
@@ -137,9 +138,7 @@ class TransformerBase:
             return x
         if rank_unique and c.axis is not None:
             key = tp.model_parallel_key(key, c.axis)
-        keep = 1.0 - c.hidden_dropout
-        mask = jax.random.bernoulli(key, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+        return inverted_dropout(x, key, c.hidden_dropout)
 
     def _attention(self, p: Params, h: jax.Array, bias=None) -> jax.Array:
         c = self.cfg

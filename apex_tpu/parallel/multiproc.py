@@ -1,0 +1,66 @@
+"""Multi-host launch glue (reference: apex/parallel/multiproc.py:1-36).
+
+The reference's launcher spawns ``world_size`` local processes with ``--rank
+i`` env plumbing (pre-``torchrun``). On TPU pods the runtime launches one
+process per host and the coordination layer is ``jax.distributed``;
+:func:`initialize_distributed` wraps it with the same env-driven UX
+(MASTER_ADDR/RANK/WORLD_SIZE names kept for reference-script migration, with
+the JAX names honored too). On a single host it is a no-op, so scripts are
+launcher-agnostic like apex examples run with or without
+``torch.distributed.launch``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize multi-host JAX if a multi-process env is configured.
+
+    Resolution order: explicit args → JAX env (``JAX_COORDINATOR_ADDRESS``…)
+    → torch-style env (``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/
+    ``RANK``, the variables apex's launcher exports, multiproc.py:20-437).
+    Returns True when distributed init ran, False for single-process."""
+    env = os.environ
+    coordinator_address = (
+        coordinator_address
+        or env.get("JAX_COORDINATOR_ADDRESS")
+        or (
+            f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '1234')}"
+            if "MASTER_ADDR" in env
+            else None
+        )
+    )
+    num_processes = num_processes or int(
+        env.get("JAX_NUM_PROCESSES", env.get("WORLD_SIZE", "1"))
+    )
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(env.get("JAX_PROCESS_ID", env.get("RANK", "0")))
+    )
+    if num_processes <= 1 or coordinator_address is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def local_rank() -> int:
+    """The LOCAL_RANK the apex launcher exports (multiproc.py:31-35).
+
+    Without the env var the TPU runtime runs one process per host, whose
+    node-local rank is 0 (jax.process_index() is the *global* rank — wrong
+    for per-node resource selection)."""
+    return int(os.environ.get("LOCAL_RANK", "0"))

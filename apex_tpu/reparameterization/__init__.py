@@ -1,0 +1,91 @@
+"""Weight-norm reparameterization (reference: apex/reparameterization/).
+
+The reference reparameterizes module weights as ``w = g * v / ||v||`` with
+the norm computed in fp32 for fp16 safety (weight_norm.py:22+), installed by
+``apply_weight_norm`` and removed by ``remove_weight_norm``
+(__init__.py:4-49). Functionally: a matching param leaf ``w`` becomes the
+pair ``{"v": w, "g": ||w||}``; :func:`materialize_weight_norm` rebuilds the
+dense weights before a forward pass (the pre-forward hook's job). Gradients
+then flow to ``v`` and ``g`` — identical math to the reference's backward
+through the reparameterization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+_WN_KEYS = ("v", "g")
+
+
+def weight_norm(v: jax.Array, g: jax.Array, dim: int = 0) -> jax.Array:
+    """``g * v / ||v||`` with norms over all dims except ``dim``, computed in
+    fp32 regardless of input dtype (the fp16-safe ``pt_norm``,
+    reparameterization/weight_norm.py:22+)."""
+    v32 = v.astype(jnp.float32)
+    axes = tuple(d for d in range(v.ndim) if d != dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v32), axis=axes, keepdims=True))
+    return (g.astype(jnp.float32).reshape(norm.shape) * v32 / norm).astype(v.dtype)
+
+
+def norm_along(w: jax.Array, dim: int = 0) -> jax.Array:
+    v32 = w.astype(jnp.float32)
+    axes = tuple(d for d in range(w.ndim) if d != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v32), axis=axes))
+
+
+def _default_match(path, leaf) -> bool:
+    """Reparameterize weight matrices: >=2-D leaves whose name suggests a
+    weight (the reference targets ``name='weight'`` by default)."""
+    name = ""
+    for p in reversed(path):
+        if hasattr(p, "key"):
+            name = str(p.key)
+            break
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2 and (
+        "weight" in name or "kernel" in name
+    )
+
+
+def apply_weight_norm(
+    params: Any,
+    match: Optional[Callable] = None,
+    dim: int = 0,
+) -> Any:
+    """Replace matching leaves ``w`` with ``{"v": w, "g": ||w||}``
+    (apply_weight_norm, reparameterization/__init__.py:4-49)."""
+    match = match or _default_match
+
+    def _convert(path, leaf):
+        if match(path, leaf):
+            return {"v": leaf, "g": norm_along(leaf, dim).astype(jnp.float32)}
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(
+        _convert, params,
+        is_leaf=lambda x: hasattr(x, "ndim"),
+    )
+
+
+def _is_wn_pair(x) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == set(_WN_KEYS)
+
+
+def materialize_weight_norm(params: Any, dim: int = 0) -> Any:
+    """Rebuild dense weights from (v, g) pairs — run this on entry to the
+    forward pass (the pre-forward hook, reference weight_norm.py)."""
+
+    def _rebuild(x):
+        if _is_wn_pair(x):
+            return weight_norm(x["v"], x["g"], dim)
+        return x
+
+    return jax.tree.map(_rebuild, params, is_leaf=_is_wn_pair)
+
+
+def remove_weight_norm(params: Any, dim: int = 0) -> Any:
+    """Collapse the reparameterization back to plain weights
+    (remove_weight_norm, reference __init__.py:27-49)."""
+    return materialize_weight_norm(params, dim)

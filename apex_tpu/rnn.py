@@ -1,0 +1,202 @@
+"""RNN / LSTM / GRU / mLSTM (reference: apex/RNN — deprecated there, kept
+for API completeness).
+
+The reference reimplements fused-dropout RNN stacks in pure python
+(RNN/models.py:19-52, RNNBackend.py:25-232, cells.py:12-55). TPU-native, the
+time loop is a ``lax.scan`` (one traced step body, compile time O(1) in
+sequence length) and the per-gate GEMMs are packed into one matmul per input
+so the MXU sees a single large contraction per step.
+
+Functional API: ``cell = LSTMCell(input_size, hidden)``;
+``params = cell.init(key)``; ``RNN([cell, ...]).apply(params_list, x)`` with
+``x: (batch, time, input)`` → ``(output, final_states)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.utils.nn import inverted_dropout
+
+Params = Dict[str, Any]
+
+
+class _Cell:
+    """Shared packed-GEMM cell plumbing. ``n_gates`` linear blocks of size
+    ``hidden`` computed as one (input+hidden) x (n_gates*hidden) matmul."""
+
+    n_gates = 1
+
+    def __init__(self, input_size: int, hidden_size: int, bias: bool = True):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.bias = bias
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> Params:
+        k1, k2 = jax.random.split(key)
+        bound = 1.0 / math.sqrt(self.hidden_size)
+        shape_i = (self.input_size, self.n_gates * self.hidden_size)
+        shape_h = (self.hidden_size, self.n_gates * self.hidden_size)
+        p = {
+            "w_ih": jax.random.uniform(k1, shape_i, dtype, -bound, bound),
+            "w_hh": jax.random.uniform(k2, shape_h, dtype, -bound, bound),
+        }
+        if self.bias:
+            p["b"] = jnp.zeros((self.n_gates * self.hidden_size,), dtype)
+        return p
+
+    def initial_state(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def _gates(self, p: Params, x, h):
+        z = x @ p["w_ih"] + h @ p["w_hh"]
+        if self.bias:
+            z = z + p["b"]
+        return z
+
+    def __call__(self, p: Params, state, x):
+        raise NotImplementedError
+
+
+class RNNReLUCell(_Cell):
+    """h' = relu(W x + U h + b) (cells.py RNNReLUCell)."""
+
+    def __call__(self, p, h, x):
+        return jax.nn.relu(self._gates(p, x, h))
+
+
+class RNNTanhCell(_Cell):
+    def __call__(self, p, h, x):
+        return jnp.tanh(self._gates(p, x, h))
+
+
+class LSTMCell(_Cell):
+    """Standard LSTM (i, f, g, o gate order; RNNBackend LSTMCell)."""
+
+    n_gates = 4
+
+    def initial_state(self, batch, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+    def __call__(self, p, state, x):
+        h, c = state
+        i, f, g, o = jnp.split(self._gates(p, x, h), 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c)
+
+
+class GRUCell(_Cell):
+    """GRU (r, z, n gates; cells.py GRUCell). The candidate gate applies the
+    reset to the hidden projection, so it gets its own GEMM block."""
+
+    n_gates = 3
+
+    def __call__(self, p, h, x):
+        zi = x @ p["w_ih"]
+        zh = h @ p["w_hh"]
+        if self.bias:
+            zi = zi + p["b"]
+        ri, zi_g, ni = jnp.split(zi, 3, axis=-1)
+        rh, zh_g, nh = jnp.split(zh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        z = jax.nn.sigmoid(zi_g + zh_g)
+        n = jnp.tanh(ni + r * nh)
+        return (1.0 - z) * n + z * h
+
+
+class mLSTMCell(LSTMCell):
+    """Multiplicative LSTM (cells.py:12-55): the hidden state is modulated by
+    ``m = (W_mx x) * (W_mh h)`` before the gate GEMM."""
+
+    def init(self, key, dtype=jnp.float32):
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = super().init(k1, dtype)
+        bound = 1.0 / math.sqrt(self.hidden_size)
+        p["w_mx"] = jax.random.uniform(
+            k2, (self.input_size, self.hidden_size), dtype, -bound, bound)
+        p["w_mh"] = jax.random.uniform(
+            k3, (self.hidden_size, self.hidden_size), dtype, -bound, bound)
+        return p
+
+    def __call__(self, p, state, x):
+        h, c = state
+        m = (x @ p["w_mx"]) * (h @ p["w_mh"])
+        i, f, g, o = jnp.split(self._gates(p, x, m), 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c)
+
+
+def _cell_output(state):
+    return state[0] if isinstance(state, tuple) else state
+
+
+class RNN:
+    """Stacked multi-layer runner (RNN/models.py:19-52 ``toRNNBackend``).
+
+    ``apply(params_list, x, initial_states=None, dropout_key=None)`` scans
+    each layer over time, with inter-layer dropout like the reference's
+    ``dropout`` arg.
+    """
+
+    def __init__(self, cells: Sequence[_Cell], dropout: float = 0.0):
+        self.cells = list(cells)
+        self.dropout = dropout
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> List[Params]:
+        keys = jax.random.split(key, len(self.cells))
+        return [c.init(k, dtype) for c, k in zip(self.cells, keys)]
+
+    def apply(
+        self,
+        params: Sequence[Params],
+        x: jax.Array,
+        initial_states: Optional[List[Any]] = None,
+        dropout_key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, List[Any]]:
+        batch = x.shape[0]
+        states = initial_states or [
+            c.initial_state(batch, x.dtype) for c in self.cells
+        ]
+        finals = []
+        h_seq = x
+        for li, (cell, p) in enumerate(zip(self.cells, params)):
+            def step(state, xt, cell=cell, p=p):
+                new = cell(p, state, xt)
+                return new, _cell_output(new)
+
+            final, ys = lax.scan(step, states[li], jnp.swapaxes(h_seq, 0, 1))
+            h_seq = jnp.swapaxes(ys, 0, 1)
+            finals.append(final)
+            if (
+                dropout_key is not None
+                and self.dropout > 0.0
+                and li < len(self.cells) - 1
+            ):
+                dropout_key, sub = jax.random.split(dropout_key)
+                h_seq = inverted_dropout(h_seq, sub, self.dropout)
+        return h_seq, finals
+
+
+def make_lstm(input_size, hidden_size, num_layers=1, bias=True, dropout=0.0) -> RNN:
+    """models.py LSTM factory."""
+    cells = [
+        LSTMCell(input_size if i == 0 else hidden_size, hidden_size, bias)
+        for i in range(num_layers)
+    ]
+    return RNN(cells, dropout)
+
+
+def make_gru(input_size, hidden_size, num_layers=1, bias=True, dropout=0.0) -> RNN:
+    cells = [
+        GRUCell(input_size if i == 0 else hidden_size, hidden_size, bias)
+        for i in range(num_layers)
+    ]
+    return RNN(cells, dropout)

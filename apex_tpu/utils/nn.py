@@ -1,0 +1,20 @@
+"""Small shared nn helpers."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def inverted_dropout(x: jax.Array, key: Optional[jax.Array], rate: float) -> jax.Array:
+    """Standard inverted dropout: identity when ``key is None`` or
+    ``rate == 0`` (eval mode), else zero with prob ``rate`` and scale the
+    survivors by ``1/keep``. One home for the pattern used across the model
+    zoo, MHA modules, RNNs, and the transducer joint."""
+    if key is None or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

@@ -1,0 +1,165 @@
+"""Aux-parity tests: weight norm, RNN zoo, transducer, ASP sparsity, launcher
+(reference: apex/reparameterization, apex/RNN, apex/contrib/{transducer,
+sparsity}, apex/parallel/multiproc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import rnn
+from apex_tpu.contrib import sparsity, transducer
+from apex_tpu.parallel.multiproc import initialize_distributed
+from apex_tpu.reparameterization import (
+    apply_weight_norm,
+    materialize_weight_norm,
+    norm_along,
+    remove_weight_norm,
+    weight_norm,
+)
+
+
+# -- weight norm ------------------------------------------------------------
+
+def test_weight_norm_reconstructs_and_normalizes():
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+    params = apply_weight_norm({"layer": {"kernel": w, "bias": jnp.zeros(4)}})
+    assert set(params["layer"]["kernel"].keys()) == {"v", "g"}
+    dense = materialize_weight_norm(params)
+    np.testing.assert_allclose(np.asarray(dense["layer"]["kernel"]),
+                               np.asarray(w), rtol=1e-5)
+    # doubling g doubles the weight; v's own scale cancels
+    p2 = jax.tree.map(lambda x: x, params)
+    p2["layer"]["kernel"] = {
+        "v": params["layer"]["kernel"]["v"] * 7.0,
+        "g": params["layer"]["kernel"]["g"] * 2.0,
+    }
+    dense2 = materialize_weight_norm(p2)
+    np.testing.assert_allclose(np.asarray(dense2["layer"]["kernel"]),
+                               2 * np.asarray(w), rtol=1e-5)
+    back = remove_weight_norm(params)
+    assert back["layer"]["kernel"].shape == (8, 4)
+
+
+def test_weight_norm_fp16_safe():
+    """Norm math runs fp32 even for half inputs (the fp16-safe norm,
+    weight_norm.py:22+)."""
+    w = (jnp.ones((4, 4)) * 100).astype(jnp.float16)  # sum of squares
+    n = norm_along(w)  # would overflow fp16 (4e4 > 65504 per-element square)
+    np.testing.assert_allclose(np.asarray(n), 200.0, rtol=1e-3)
+    out = weight_norm(w, jnp.ones(4) * 200.0)
+    assert out.dtype == jnp.float16
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+# -- RNN zoo ----------------------------------------------------------------
+
+@pytest.mark.parametrize("factory", [rnn.make_lstm, rnn.make_gru])
+def test_rnn_shapes_and_gradients(factory):
+    net = factory(6, 8, num_layers=2)
+    params = net.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 5, 6))
+    out, finals = net.apply(params, x)
+    assert out.shape == (3, 5, 8)
+    loss, grads = jax.value_and_grad(
+        lambda p: jnp.sum(jnp.square(net.apply(p, x)[0])))(params)
+    assert jnp.isfinite(loss)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(grads))
+
+
+def test_lstm_matches_manual_step():
+    cell = rnn.LSTMCell(4, 4)
+    p = cell.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 1, 4))
+    out, [(h, c)] = rnn.RNN([cell]).apply([p], x)
+    # manual single step
+    z = x[:, 0] @ p["w_ih"] + jnp.zeros((2, 4)) @ p["w_hh"] + p["b"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c_ref = jax.nn.sigmoid(f) * 0 + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_ref = jax.nn.sigmoid(o) * jnp.tanh(c_ref)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(h_ref), rtol=1e-5)
+
+
+def test_mlstm_runs():
+    cell = rnn.mLSTMCell(5, 7)
+    p = cell.init(jax.random.PRNGKey(0))
+    net = rnn.RNN([cell])
+    out, _ = net.apply([p], jax.random.normal(jax.random.PRNGKey(1), (2, 6, 5)))
+    assert out.shape == (2, 6, 7)
+
+
+# -- transducer -------------------------------------------------------------
+
+def test_transducer_joint_broadcast():
+    f = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 4))
+    g = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 4))
+    out = transducer.transducer_joint(f, g)
+    assert out.shape == (2, 3, 5, 4)
+    np.testing.assert_allclose(
+        np.asarray(out[1, 2, 3]), np.asarray(f[1, 2] + g[1, 3]), rtol=1e-6)
+
+
+def test_transducer_loss_matches_reference_dp():
+    B, T, U, V = 3, 6, 4, 8
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (B, T, U + 1, V))
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    targets = jax.random.randint(jax.random.PRNGKey(1), (B, U), 1, V)
+    f_len = jnp.asarray([6, 4, 5])
+    y_len = jnp.asarray([4, 2, 3])
+    loss = transducer.transducer_loss(log_probs, targets, f_len, y_len)
+    ref = transducer.transducer_loss_reference(log_probs, targets, f_len, y_len)
+    np.testing.assert_allclose(np.asarray(loss), ref, rtol=1e-4)
+
+
+def test_transducer_loss_gradients_flow():
+    B, T, U, V = 2, 4, 3, 6
+    logits = jax.random.normal(jax.random.PRNGKey(0), (B, T, U + 1, V))
+    targets = jax.random.randint(jax.random.PRNGKey(1), (B, U), 1, V)
+    f_len = jnp.asarray([4, 3])
+    y_len = jnp.asarray([3, 2])
+
+    def loss_fn(lg):
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return jnp.mean(transducer.transducer_loss(lp, targets, f_len, y_len))
+
+    g = jax.grad(loss_fn)(logits)
+    assert bool(jnp.all(jnp.isfinite(g)))
+    assert float(jnp.abs(g).max()) > 0
+
+
+# -- ASP sparsity -----------------------------------------------------------
+
+def test_m4n2_mask_keeps_top2_per_group():
+    w = jnp.asarray([[1.0, -5.0, 0.1, 3.0, 9.0, -0.2, 0.3, -8.0]])
+    m = sparsity.m4n2_mask_1d(w)
+    np.testing.assert_array_equal(
+        np.asarray(m), [[False, True, False, True, True, False, False, True]])
+
+
+def test_asp_workflow_masks_and_remains_sparse():
+    params = {
+        "dense": {"kernel": jax.random.normal(jax.random.PRNGKey(0), (16, 8)),
+                  "bias": jnp.ones((8,))},
+        "odd": jnp.ones((5,)),  # not prunable
+    }
+    masks = sparsity.compute_sparse_masks(params)
+    assert masks["odd"] is None and masks["dense"]["bias"] is None
+    pruned = sparsity.apply_masks(params, masks)
+    assert sparsity.sparsity_ratio(pruned, masks) == pytest.approx(0.5)
+    # simulated optimizer update densifies; re-mask restores the pattern
+    updated = jax.tree.map(lambda p: p + 0.01, pruned)
+    remasked = sparsity.apply_masks(updated, masks)
+    zeros = np.asarray(remasked["dense"]["kernel"]) == 0
+    assert zeros.reshape(-1, 4).sum(1).min() >= 2
+
+
+# -- launcher ---------------------------------------------------------------
+
+def test_initialize_distributed_single_process_noop(monkeypatch):
+    for var in ("MASTER_ADDR", "WORLD_SIZE", "RANK", "JAX_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize_distributed() is False
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    assert initialize_distributed() is False
