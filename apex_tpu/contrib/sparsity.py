@@ -29,26 +29,32 @@ import jax
 import jax.numpy as jnp
 
 
-def m4n2_mask_1d(w: jax.Array) -> jax.Array:
-    """2-of-4 magnitude mask along the last dim (sparse_masklib.py mn_1d_best
-    for m=4, n=2). Last dim must be divisible by 4."""
-    if w.shape[-1] % 4:
-        raise ValueError(f"last dim {w.shape[-1]} not divisible by 4")
-    groups = jnp.abs(w).reshape(*w.shape[:-1], -1, 4)
+def m4n2_mask_1d(w: jax.Array, axis: int = -2) -> jax.Array:
+    """2-of-4 magnitude mask along ``axis`` (sparse_masklib.py mn_1d_best for
+    m=4, n=2). The default ``axis=-2`` is the **contraction/input dim** of
+    this codebase's ``(in, out)`` kernels — the dim apex ASP prunes (torch
+    ``(out, in)`` weights masked along dim 1), which is what the sparse
+    tensor-core GEMM contracts over."""
+    axis = axis % w.ndim
+    if w.shape[axis] % 4:
+        raise ValueError(f"dim {axis} of size {w.shape[axis]} not divisible by 4")
+    wm = jnp.moveaxis(w, axis, -1)
+    groups = jnp.abs(wm).reshape(*wm.shape[:-1], -1, 4)
     # rank within each group of 4; keep the top 2
     order = jnp.argsort(groups, axis=-1)  # ascending
     ranks = jnp.argsort(order, axis=-1)
-    mask = (ranks >= 2).reshape(w.shape)
-    return mask
+    mask = (ranks >= 2).reshape(wm.shape)
+    return jnp.moveaxis(mask, -1, axis)
 
 
 def _default_allow(path, leaf) -> bool:
-    """Prune 2-D+ weight leaves with input dim divisible by 4 (the reference
-    prunes Linear/Conv weights with shape constraints, asp.py:110-143)."""
+    """Prune 2-D+ weight leaves with input (contraction) dim divisible by 4
+    (the reference prunes Linear/Conv weights with shape constraints,
+    asp.py:110-143)."""
     return (
         hasattr(leaf, "ndim")
         and leaf.ndim >= 2
-        and leaf.shape[-1] % 4 == 0
+        and leaf.shape[-2] % 4 == 0
         and jnp.issubdtype(leaf.dtype, jnp.floating)
     )
 

@@ -139,7 +139,7 @@ class TokenLoader:
         self.loop = loop
         self._lib = _get()
         self._n_buffers = n_buffers
-        self._handle = None
+        self._handles: set = set()
 
     def _create_handle(self):
         arr = (ctypes.c_char_p * len(self.paths))(*[p.encode() for p in self.paths])
@@ -153,17 +153,20 @@ class TokenLoader:
         return self._python_iter()
 
     def _native_iter(self):
-        self.close()  # retire any previous stream
-        self._handle = self._create_handle()
+        # each iterator owns its stream: concurrent iterators are independent
+        handle = self._create_handle()
+        self._handles.add(handle)
         out = np.empty(self.batch_shape, self.dtype)
         try:
             while True:
-                ok = self._lib.tl_next(self._handle, out.ctypes.data_as(ctypes.c_void_p))
+                ok = self._lib.tl_next(handle, out.ctypes.data_as(ctypes.c_void_p))
                 if not ok:
                     return
                 yield out.copy()
         finally:
-            self.close()
+            if handle in self._handles:
+                self._handles.discard(handle)
+                self._lib.tl_destroy(handle)
 
     def _python_iter(self):
         carry = b""
@@ -181,9 +184,9 @@ class TokenLoader:
                 return
 
     def close(self):
-        if self._handle is not None:
-            self._lib.tl_destroy(self._handle)
-            self._handle = None
+        """Stop all live native streams."""
+        while self._handles:
+            self._lib.tl_destroy(self._handles.pop())
 
     def __del__(self):  # pragma: no cover - GC timing
         try:

@@ -47,8 +47,13 @@ def initialize_distributed(
         if process_id is not None
         else int(env.get("JAX_PROCESS_ID", env.get("RANK", "0")))
     )
-    if num_processes <= 1 or coordinator_address is None:
+    if num_processes <= 1:
         return False
+    if coordinator_address is None:
+        raise RuntimeError(
+            f"WORLD_SIZE/JAX_NUM_PROCESSES={num_processes} but no coordinator "
+            "address (set MASTER_ADDR[:MASTER_PORT] or JAX_COORDINATOR_ADDRESS)"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

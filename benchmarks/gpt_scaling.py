@@ -1,0 +1,155 @@
+"""GPT scaling harness (reference: tests/L0/run_transformer/gpt_scaling_test.py:49-70).
+
+The reference sweeps (dp, tp, pp) in {(8,1,1), (4,2,1), (2,1,4), (1,2,4)} over
+8 GPUs, growing layer counts, parsing "Average Iteration Time" from each
+subprocess — a throughput regression harness. Here each configuration runs
+in-process on the mesh (virtual CPU devices in CI, real chips on a pod) and
+the harness prints one JSON line per config:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/gpt_scaling.py --steps 3 --hidden 128 --layers 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel import collectives, mesh as mesh_lib
+from apex_tpu.parallel.distributed import (
+    allreduce_gradients,
+    allreduce_gradients_by_spec,
+)
+from apex_tpu.transformer import tensor_parallel as tp_mod
+from apex_tpu.transformer.pipeline_parallel import pipeline_specs, pipelined_loss_fn
+
+# the reference grid, gpt_scaling_test.py:52
+GRID = [(8, 1, 1), (4, 2, 1), (2, 1, 4), (1, 2, 4)]
+
+
+def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
+               micro_batch, n_micro, steps):
+    n_dev = dp * tp * pp
+    if len(jax.devices()) < n_dev:
+        return None
+    mesh = mesh_lib.make_virtual_mesh(
+        n_dev, tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp)
+    try:
+        cfg = GPTConfig(
+            vocab_size=vocab, hidden_size=hidden,
+            num_layers=max(layers, pp) // pp * pp,
+            num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
+            axis=mesh_lib.AXIS_MODEL if tp > 1 else None,
+            compute_dtype=jnp.bfloat16, remat=True,
+        )
+        model = GPTModel(cfg)
+        policy = amp.get_policy("O2")
+        mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-4), policy)
+        full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+        all_specs = model.specs()
+        specs = dict(
+            {k: v for k, v in all_specs.items() if k != "layers"},
+            layers=pipeline_specs(all_specs["layers"]),
+        )
+        params = tp_mod.shard_params(full, specs, mesh)
+        opt_state = mp_opt.init(params)
+        rest_specs = {k: v for k, v in all_specs.items() if k != "layers"}
+        grad_axes = mesh_lib.get_gradient_reduction_axes()
+        pipe_loss = pipelined_loss_fn(
+            embed=model.embed,
+            run_layers=lambda lp, h: model.run_layers(lp, h),
+            head_loss=lambda p, h, t: model.head(p, h, t),
+            num_microbatches=n_micro,
+        )
+        data_spec = P(mesh_lib.AXIS_DATA)
+
+        def sharded_grads(p, toks, tgts, scale):
+            rest = {k: v for k, v in p.items() if k != "layers"}
+
+            def scaled_loss(rest, layers):
+                return pipe_loss(rest, layers, toks, tgts) * scale
+
+            loss, (rg, lg) = jax.value_and_grad(scaled_loss, argnums=(0, 1))(
+                rest, p["layers"])
+            rg = allreduce_gradients_by_spec(rg, rest_specs)
+            lg = allreduce_gradients(lg, grad_axes)
+            return collectives.pmean(loss, grad_axes), dict(rg, layers=lg)
+
+        shard_fn = jax.shard_map(
+            sharded_grads, mesh=mesh,
+            in_specs=(specs, data_spec, data_spec, P()),
+            out_specs=(P(), specs), check_vma=False)
+
+        @jax.jit
+        def train_step(params, opt_state, tokens, targets):
+            sl, sg = shard_fn(params, tokens, targets, opt_state.scaler.loss_scale)
+            np_, ns, m = mp_opt.apply_gradients(opt_state, params, sg)
+            return np_, ns, sl / opt_state.scaler.loss_scale, m
+
+        batch = micro_batch * dp * n_micro
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(0, vocab, (batch, seq)))
+        tgts = jnp.roll(toks, -1, axis=-1)
+        shard = lambda a: jax.device_put(a, NamedSharding(mesh, data_spec))
+        toks, tgts = shard(toks), shard(tgts)
+
+        params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
+        float(loss)  # compile + execute barrier
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
+        loss_val = float(loss)  # host fetch forces the whole chain
+        dt = (time.perf_counter() - t0) / steps
+        return {
+            "config": {"dp": dp, "tp": tp, "pp": pp},
+            "avg_iteration_time_s": round(dt, 4),
+            "tokens_per_sec": round(batch * seq / dt, 1),
+            "loss": round(loss_val, 4),
+        }
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=2048)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--micro-batch", type=int, default=1)
+    p.add_argument("--num-microbatches", type=int, default=2)
+    p.add_argument("--steps", type=int, default=3)
+    args = p.parse_args()
+    for dp, tp, pp in GRID:
+        res = run_config(
+            dp, tp, pp, hidden=args.hidden, layers=args.layers,
+            heads=args.heads, vocab=args.vocab, seq=args.seq,
+            micro_batch=args.micro_batch, n_micro=args.num_microbatches,
+            steps=args.steps)
+        if res is None:
+            print(json.dumps({"config": {"dp": dp, "tp": tp, "pp": pp},
+                              "skipped": "not enough devices"}))
+        else:
+            print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

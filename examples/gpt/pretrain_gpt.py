@@ -1,0 +1,189 @@
+"""GPT pretraining with hybrid TP x PP x DP over a device mesh.
+
+The flagship recipe (reference: apex/transformer/testing/standalone_gpt.py
+driven by run_gpt_minimal_test.py / gpt_scaling_test.py): Megatron-style GPT
+with tensor parallelism over the ``model`` axis, SPMD pipeline over ``pipe``,
+data parallelism over ``data``, O2 mixed precision with fused Adam and
+dynamic loss scaling, streaming token batches (native TokenLoader or
+synthetic), and periodic checkpointing.
+
+Run on 8 virtual devices:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/gpt/pretrain_gpt.py --tp 2 --pp 2 --steps 10
+Run serial on one real TPU chip:
+    python examples/gpt/pretrain_gpt.py --tp 1 --pp 1 --steps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp, checkpoint
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel import collectives, mesh as mesh_lib
+from apex_tpu.parallel.distributed import (
+    allreduce_gradients,
+    allreduce_gradients_by_spec,
+)
+from apex_tpu.parallel.multiproc import initialize_distributed
+from apex_tpu.transformer import tensor_parallel as tp_mod
+from apex_tpu.transformer.pipeline_parallel import pipeline_specs, pipelined_loss_fn
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=50304)
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--micro-batch", type=int, default=2)
+    p.add_argument("--num-microbatches", type=int, default=2)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--data", default=None, help="dir of .bin int32 token files")
+    p.add_argument("--save-dir", default=None)
+    p.add_argument("--save-every", type=int, default=100)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    initialize_distributed()  # no-op single-process
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.make_virtual_mesh(
+        n_dev,
+        tensor_model_parallel_size=args.tp,
+        pipeline_model_parallel_size=args.pp,
+    )
+    dp = mesh_lib.get_data_parallel_world_size()
+    assert args.layers % max(args.pp, 1) == 0
+
+    cfg = GPTConfig(
+        vocab_size=args.vocab,
+        hidden_size=args.hidden,
+        num_layers=args.layers,
+        num_attention_heads=args.heads,
+        max_seq_len=args.seq,
+        hidden_dropout=0.0,
+        axis=mesh_lib.AXIS_MODEL if args.tp > 1 else None,
+        compute_dtype=jnp.bfloat16 if args.opt_level in ("O1", "O2", "O3") else jnp.float32,
+        remat=True,
+    )
+    model = GPTModel(cfg)
+    policy = amp.get_policy(args.opt_level)
+    mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=args.lr), policy)
+
+    full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    all_specs = model.specs()
+    specs = dict(
+        {k: v for k, v in all_specs.items() if k != "layers"},
+        layers=pipeline_specs(all_specs["layers"]),
+    )
+    params = tp_mod.shard_params(full, specs, mesh)
+    opt_state = mp_opt.init(params)
+
+    batch = args.micro_batch * dp * args.num_microbatches
+    data_spec = P(mesh_lib.AXIS_DATA)
+    rest_specs = {k: v for k, v in all_specs.items() if k != "layers"}
+    grad_axes = mesh_lib.get_gradient_reduction_axes()
+    pipe_loss = pipelined_loss_fn(
+        embed=model.embed,
+        run_layers=lambda lp, h: model.run_layers(lp, h),
+        head_loss=lambda p, h, t: model.head(p, h, t),
+        num_microbatches=args.num_microbatches,
+    )
+
+    def sharded_grads(p, toks, tgts, scale):
+        rest = {k: v for k, v in p.items() if k != "layers"}
+
+        def scaled_loss(rest, layers):
+            return pipe_loss(rest, layers, toks, tgts) * scale
+
+        loss, (rest_g, layer_g) = jax.value_and_grad(scaled_loss, argnums=(0, 1))(
+            rest, p["layers"])
+        rest_g = allreduce_gradients_by_spec(rest_g, rest_specs)
+        layer_g = allreduce_gradients(layer_g, grad_axes)
+        return collectives.pmean(loss, grad_axes), dict(rest_g, layers=layer_g)
+
+    shard_fn = jax.shard_map(
+        sharded_grads, mesh=mesh,
+        in_specs=(specs, data_spec, data_spec, P()),
+        out_specs=(P(), specs), check_vma=False,
+    )
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        scaled_loss, scaled_grads = shard_fn(
+            params, tokens, targets, opt_state.scaler.loss_scale)
+        new_params, new_state, metrics = mp_opt.apply_gradients(
+            opt_state, params, scaled_grads)
+        return new_params, new_state, scaled_loss / opt_state.scaler.loss_scale, metrics
+
+    if args.data:
+        from apex_tpu.csrc import TokenLoader
+        files = sorted(
+            os.path.join(args.data, f) for f in os.listdir(args.data)
+            if f.endswith(".bin"))
+        batches = iter(TokenLoader(files, (batch, args.seq + 1), loop=True))
+
+        def next_batch():
+            arr = jnp.asarray(next(batches) % args.vocab)
+            return arr[:, :-1], arr[:, 1:]
+    else:
+        rng = np.random.default_rng(0)
+
+        def next_batch():
+            toks = jnp.asarray(rng.integers(0, args.vocab, (batch, args.seq)))
+            return toks, jnp.roll(toks, -1, axis=-1)
+
+    shard = lambda a: jax.device_put(a, NamedSharding(mesh, data_spec))
+    start = 0
+    if args.save_dir and (step := checkpoint.latest_step(args.save_dir)) is not None:
+        restored = checkpoint.restore_checkpoint(
+            args.save_dir, {"params": params, "opt": opt_state})
+        params, opt_state = restored["params"], restored["opt"]
+        start = step
+        print(f"resumed from step {step}")
+
+    t0 = time.perf_counter()
+    for i in range(start, start + args.steps):
+        toks, tgts = next_batch()
+        params, opt_state, loss, metrics = train_step(
+            params, opt_state, shard(toks), shard(tgts))
+        if i == start:
+            float(loss)  # exclude compile
+            t0 = time.perf_counter()
+        if i % 5 == 0 or i == start + args.steps - 1:
+            print(f"step {i:5d} loss {float(loss):.4f} "
+                  f"scale {float(metrics['loss_scale']):.0f}")
+        if args.save_dir and (i + 1) % args.save_every == 0:
+            checkpoint.save_checkpoint(
+                args.save_dir, i + 1, {"params": params, "opt": opt_state})
+    n_done = max(args.steps - 1, 1)
+    dt = (time.perf_counter() - t0) / n_done
+    print(f"{batch * args.seq / dt:.0f} tokens/s | mesh: tp={args.tp} pp={args.pp} "
+          f"dp={dp} | {dt * 1e3:.1f} ms/step")
+    mesh_lib.destroy_model_parallel()
+
+
+if __name__ == "__main__":
+    main()

@@ -133,9 +133,19 @@ def test_transducer_loss_gradients_flow():
 
 def test_m4n2_mask_keeps_top2_per_group():
     w = jnp.asarray([[1.0, -5.0, 0.1, 3.0, 9.0, -0.2, 0.3, -8.0]])
-    m = sparsity.m4n2_mask_1d(w)
+    m = sparsity.m4n2_mask_1d(w, axis=-1)
     np.testing.assert_array_equal(
         np.asarray(m), [[False, True, False, True, True, False, False, True]])
+
+
+def test_m4n2_mask_default_axis_is_contraction_dim():
+    """Default pruning runs along the (in, out) kernel's input dim — the dim
+    apex ASP prunes (torch (out, in) masked along dim 1)."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 3))
+    m = sparsity.m4n2_mask_1d(w)
+    # exactly 2 of every contiguous 4 along axis 0 survive, per column
+    kept = np.asarray(m).reshape(2, 4, 3).sum(axis=1)
+    np.testing.assert_array_equal(kept, np.full((2, 3), 2))
 
 
 def test_asp_workflow_masks_and_remains_sparse():
@@ -152,14 +162,27 @@ def test_asp_workflow_masks_and_remains_sparse():
     updated = jax.tree.map(lambda p: p + 0.01, pruned)
     remasked = sparsity.apply_masks(updated, masks)
     zeros = np.asarray(remasked["dense"]["kernel"]) == 0
-    assert zeros.reshape(-1, 4).sum(1).min() >= 2
+    # groups of 4 along the input dim (axis 0), per output column
+    assert zeros.T.reshape(-1, 4).sum(1).min() >= 2
 
 
 # -- launcher ---------------------------------------------------------------
 
 def test_initialize_distributed_single_process_noop(monkeypatch):
-    for var in ("MASTER_ADDR", "WORLD_SIZE", "RANK", "JAX_COORDINATOR_ADDRESS"):
+    for var in ("MASTER_ADDR", "WORLD_SIZE", "RANK", "JAX_COORDINATOR_ADDRESS",
+                "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
         monkeypatch.delenv(var, raising=False)
     assert initialize_distributed() is False
     monkeypatch.setenv("WORLD_SIZE", "1")
     assert initialize_distributed() is False
+
+
+def test_initialize_distributed_partial_env_errors(monkeypatch):
+    """WORLD_SIZE>1 without a coordinator address must fail loudly, not
+    silently run N uncoordinated single-process worlds."""
+    for var in ("MASTER_ADDR", "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    monkeypatch.setenv("RANK", "0")
+    with pytest.raises(RuntimeError, match="no coordinator"):
+        initialize_distributed()

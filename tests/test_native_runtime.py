@@ -73,12 +73,26 @@ def test_token_loader_loop_mode(tmp_path):
     loader.close()
 
 
+def test_token_loader_concurrent_iterators_independent(tmp_path):
+    tokens = np.arange(64, dtype=np.int32)
+    (tmp_path / "t.bin").write_bytes(tokens.tobytes())
+    loader = csrc.TokenLoader([tmp_path / "t.bin"], batch_shape=(16,))
+    it1, it2 = iter(loader), iter(loader)
+    a1 = next(it1)
+    b1 = next(it2)  # starting it2 must not kill it1's stream
+    a2 = next(it1)
+    np.testing.assert_array_equal(a1, tokens[:16])
+    np.testing.assert_array_equal(b1, tokens[:16])
+    np.testing.assert_array_equal(a2, tokens[16:32])
+    loader.close()
+
+
 def test_token_loader_python_fallback_equivalence(tmp_path):
     tokens = np.arange(200, dtype=np.int32)
     (tmp_path / "t.bin").write_bytes(tokens.tobytes())
     native = list(csrc.TokenLoader([tmp_path / "t.bin"], batch_shape=(8, 8)))
     fb = csrc.TokenLoader([tmp_path / "t.bin"], batch_shape=(8, 8))
-    fb._handle = None  # force python path
+    fb._lib = None  # force python path
     python = list(fb)
     assert len(native) == len(python) == 3
     for a, b in zip(native, python):
