@@ -11,3 +11,9 @@ from apex_tpu.amp.frontend import (  # noqa: F401
     MPOptState,
     initialize,
 )
+from apex_tpu.amp.functions import (  # noqa: F401
+    float_function,
+    half_function,
+    promote_function,
+    set_active_policy,
+)

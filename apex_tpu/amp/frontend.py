@@ -247,6 +247,10 @@ def initialize(
             rank0=True,
         )
 
+    # arm the O1-style function registries (amp.py:68-177's patch install)
+    from apex_tpu.amp.functions import set_active_policy
+
+    set_active_policy(policy)
     cast = _precision.cast_params(params, policy)
     if optimizers is None:
         if apply_fn is not None:

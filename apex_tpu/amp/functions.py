@@ -1,0 +1,88 @@
+"""User-registered precision-cast wrappers — the O1 decorator surface
+(reference: apex/amp/amp.py:29-64 ``register_half_function`` /
+``register_float_function`` / ``register_promote_function`` and the
+``half_function``/``float_function``/``promote_function`` decorators).
+
+The reference monkey-patches modules at ``amp.init`` time; under tracing, a
+wrapper applied at call sites is the honest equivalent: it casts floating
+array args to the target dtype on entry. Policies with a cast model (O2/O3)
+make these wrappers no-ops for half functions (the network already runs in
+compute dtype), matching the reference where the O1 patcher is only
+installed when ``patch_torch_functions`` is set.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import precision as _precision
+
+# Module-level active policy, set by amp.initialize (the _amp_state analog).
+_active_policy: Optional[_precision.Policy] = None
+
+
+def set_active_policy(policy: Optional[_precision.Policy]) -> None:
+    global _active_policy
+    _active_policy = policy
+
+
+def _cast_floats(args, kwargs, dtype):
+    def _c(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact):
+            return a.astype(dtype)
+        return a
+
+    return jax.tree.map(_c, (args, kwargs))
+
+
+def half_function(fn: Callable) -> Callable:
+    """Run ``fn`` in the policy's compute dtype (FP16-whitelist;
+    amp.py:38-41, the MLP module registers itself this way, mlp.py:24)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        p = _active_policy
+        if p is None or p.compute_dtype == jnp.float32:
+            return fn(*args, **kwargs)
+        args, kwargs = _cast_floats(args, kwargs, p.compute_dtype)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def float_function(fn: Callable) -> Callable:
+    """Run ``fn`` in fp32 (FP32-blacklist: losses, norms, exp/log families;
+    amp.py:43-46)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if _active_policy is None:
+            return fn(*args, **kwargs)
+        args, kwargs = _cast_floats(args, kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Promote all floating args to the widest floating dtype present
+    (multi-arg type promotion; amp.py:48-51, torch_overrides.py:86-115)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if _active_policy is None:
+            return fn(*args, **kwargs)
+        leaves = jax.tree.leaves((args, kwargs))
+        dts = [a.dtype for a in leaves
+               if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact)]
+        if not dts:
+            return fn(*args, **kwargs)
+        widest = functools.reduce(jnp.promote_types, dts)
+        args, kwargs = _cast_floats(args, kwargs, widest)
+        return fn(*args, **kwargs)
+
+    return wrapped

@@ -1,0 +1,101 @@
+"""FMHA — fused multi-head attention for variable-length batches
+(reference: apex/contrib/fmha/fmha.py:33-74 + fmhalib kernels).
+
+The reference packs a batch of unequal-length sequences into one
+``(total_tokens, 3, heads, head_dim)`` qkv tensor with ``cu_seqlens``
+boundaries and runs a flash-style kernel (fp16, seqlen ≤ 512, SM80).
+On TPU the flash kernel in ``apex_tpu.ops.flash_attention`` is the engine;
+variable length is expressed by unpacking to a padded ``(b, h, s, d)`` batch
+with a key-padding bias — XLA-friendly static shapes, one kernel launch for
+the whole batch, no per-sequence loops. The packed cu_seqlens calling
+convention is preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+
+
+def fmha(
+    qkv: jax.Array,
+    cu_seqlens: jax.Array,
+    max_seqlen: int,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Packed varlen attention (``FMHAFun``, fmha.py:33-60).
+
+    Args:
+      qkv: ``(total_tokens, 3, heads, head_dim)`` packed sequences.
+      cu_seqlens: ``(batch+1,)`` cumulative sequence boundaries
+        (``cu_seqlens[i]``..``cu_seqlens[i+1]`` is sequence ``i``).
+      max_seqlen: pad target (static; the reference buckets {128,256,384,512}).
+
+    Returns packed ``(total_tokens, heads, head_dim)`` context.
+    """
+    total, three, h, d = qkv.shape
+    if three != 3:
+        raise ValueError(f"expected packed qkv with dim-1 == 3, got {three}")
+    b = cu_seqlens.shape[0] - 1
+    starts = cu_seqlens[:-1]
+    lengths = cu_seqlens[1:] - starts
+    if not isinstance(cu_seqlens, jax.core.Tracer):
+        # concrete boundaries: enforce the envelope host-side (the reference
+        # kernel rejects out-of-envelope seqlens at dispatch, fmha_api.cpp);
+        # a too-long sequence would otherwise be silently truncated to zeros.
+        import numpy as _np
+
+        max_len = int(_np.max(_np.asarray(lengths)))
+        if max_len > max_seqlen:
+            raise ValueError(
+                f"sequence length {max_len} exceeds max_seqlen {max_seqlen}"
+            )
+
+    # unpack: gather each sequence's tokens into (b, max_seqlen, ...) with
+    # out-of-range rows clamped (masked out below anyway)
+    pos = jnp.arange(max_seqlen)
+    idx = jnp.minimum(starts[:, None] + pos[None, :], total - 1)  # (b, s)
+    padded = qkv[idx]  # (b, s, 3, h, d)
+    valid = pos[None, :] < lengths[:, None]  # (b, s)
+
+    q = padded[:, :, 0].transpose(0, 2, 1, 3)  # (b, h, s, d)
+    k = padded[:, :, 1].transpose(0, 2, 1, 3)
+    v = padded[:, :, 2].transpose(0, 2, 1, 3)
+    bias = jnp.where(valid[:, None, None, :], 0.0, -10000.0).astype(jnp.float32)
+    ctx = flash_attention(q, k, v, bias=bias, causal=causal)  # (b, h, s, d)
+    ctx = ctx.transpose(0, 2, 1, 3)  # (b, s, h, d)
+
+    # repack: scatter valid rows back to (total, h, d)
+    flat_idx = (starts[:, None] + pos[None, :]).reshape(-1)
+    flat_valid = valid.reshape(-1)
+    flat_ctx = ctx.reshape(b * max_seqlen, h, d)
+    out = jnp.zeros((total, h, d), ctx.dtype)
+    return out.at[jnp.where(flat_valid, flat_idx, total)].set(
+        flat_ctx, mode="drop"
+    )
+
+
+def fmha_reference(qkv, cu_seqlens, causal=False):
+    """Per-sequence unfused ground truth for tests."""
+    import numpy as np
+
+    qkv = np.asarray(qkv, np.float32)
+    cu = np.asarray(cu_seqlens)
+    total, _, h, d = qkv.shape
+    out = np.zeros((total, h, d), np.float32)
+    for i in range(len(cu) - 1):
+        s, e = int(cu[i]), int(cu[i + 1])
+        q, k, v = qkv[s:e, 0], qkv[s:e, 1], qkv[s:e, 2]  # (L, h, d)
+        scores = np.einsum("qhd,khd->hqk", q, k) / np.sqrt(d)
+        if causal:
+            L = e - s
+            scores = np.where(np.tril(np.ones((L, L), bool)), scores, -np.inf)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[s:e] = np.einsum("hqk,khd->qhd", p, v)
+    return out

@@ -1,0 +1,45 @@
+"""FastLayerNorm (reference: apex/contrib/layer_norm/layer_norm.py:8-53).
+
+The reference's "fast" LN is a separately-tuned CUDA kernel restricted to
+hidden sizes that are multiples of 8 up to 65536; on TPU the one Pallas
+kernel in ``apex_tpu.ops.layer_norm`` already covers that envelope (the whole
+row lives in VMEM), so FastLayerNorm is the same kernel behind the contrib
+name — with the reference's constructor validation kept so migrating code
+fails in the same places.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import layer_norm
+
+Params = Dict[str, Any]
+
+
+class FastLayerNorm:
+    """``FastLayerNorm(hidden_size)``; ``init(key)`` → {weight, bias};
+    ``apply(params, x)`` (layer_norm.py:31-53)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-5):
+        if hidden_size % 8 != 0 or not (0 < hidden_size <= 65536):
+            # the reference kernel's support envelope (ln_api.cpp dispatch)
+            raise ValueError(
+                f"hidden_size {hidden_size} unsupported: must be a multiple "
+                "of 8 in (0, 65536]"
+            )
+        self.hidden_size = hidden_size
+        self.epsilon = eps
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> Params:
+        del key  # LN init is deterministic (ones/zeros)
+        return {
+            "weight": jnp.ones((self.hidden_size,), dtype),
+            "bias": jnp.zeros((self.hidden_size,), dtype),
+        }
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        return layer_norm(x, params["weight"], params["bias"], self.epsilon)

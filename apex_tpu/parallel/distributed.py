@@ -139,3 +139,33 @@ class DistributedDataParallel:
     def value_and_grad(self, params, *args, **kwargs):
         loss, grads = jax.value_and_grad(self.loss_fn)(params, *args, **kwargs)
         return loss, allreduce_gradients(grads, self.axes, **self.opts)
+
+
+class Reducer:
+    """Manually-triggered gradient (or param) averaging — the lightweight
+    alternative to DDP (apex/parallel/distributed.py:89-126: "allreduce is
+    done manually via <reducer>.reduce(); useful for custom update
+    intervals").
+
+    >>> red = Reducer()
+    >>> grads = accumulate(...)       # any number of local steps
+    >>> grads = red.reduce(grads)     # inside shard_map, when you choose
+    """
+
+    def __init__(
+        self,
+        axes: AxisNames = (AXIS_DATA, AXIS_CONTEXT),
+        *,
+        gradient_average: bool = True,
+        allreduce_always_fp32: bool = False,
+        gradient_predivide_factor: float = 1.0,
+    ):
+        self.axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        self.opts = dict(
+            gradient_average=gradient_average,
+            allreduce_always_fp32=allreduce_always_fp32,
+            gradient_predivide_factor=gradient_predivide_factor,
+        )
+
+    def reduce(self, tree: Any) -> Any:
+        return allreduce_gradients(tree, self.axes, **self.opts)

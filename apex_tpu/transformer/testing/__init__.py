@@ -1,0 +1,17 @@
+"""apex_tpu.transformer.testing — test/benchmark harness utilities.
+
+Reference: apex/transformer/testing/ — the Megatron-style global argument
+parser (arguments.py, 808 LoC), global-vars singleton (global_vars.py), and
+distributed-test helpers (commons.py). The standalone GPT/BERT models the
+reference vendors here live in ``apex_tpu.models`` as first-class citizens.
+"""
+
+from apex_tpu.transformer.testing.arguments import parse_args  # noqa: F401
+from apex_tpu.transformer.testing.commons import (  # noqa: F401
+    initialize_distributed,
+    set_random_seed,
+)
+from apex_tpu.transformer.testing.global_vars import (  # noqa: F401
+    get_args,
+    set_args,
+)

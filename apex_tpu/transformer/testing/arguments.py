@@ -1,0 +1,62 @@
+"""Megatron-style argument parser (reference: apex/transformer/testing/
+arguments.py — 808 LoC of argparse groups; this keeps the knobs the TPU
+framework actually consumes, same names and defaults so reference launch
+scripts port by search-and-replace).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+
+def parse_args(args: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="apex_tpu Megatron-style arguments")
+
+    g = p.add_argument_group("model")
+    g.add_argument("--num-layers", type=int, default=24)
+    g.add_argument("--hidden-size", type=int, default=1024)
+    g.add_argument("--num-attention-heads", type=int, default=16)
+    g.add_argument("--seq-length", type=int, default=1024)
+    g.add_argument("--max-position-embeddings", type=int, default=1024)
+    g.add_argument("--vocab-size", type=int, default=50304)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--init-method-std", type=float, default=0.02)
+
+    g = p.add_argument_group("parallel")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int, default=None)
+    g.add_argument("--pipeline-model-parallel-split-rank", type=int, default=None)
+    g.add_argument("--context-parallel-size", type=int, default=1)
+
+    g = p.add_argument_group("batch")
+    g.add_argument("--micro-batch-size", type=int, default=1)
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs=3, type=int, default=None,
+                   metavar=("START", "INCREMENT", "SAMPLES"))
+
+    g = p.add_argument_group("precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None,
+                   help="static loss scale; None selects dynamic")
+    g.add_argument("--initial-loss-scale", type=float, default=2.0 ** 16)
+    g.add_argument("--loss-scale-window", type=int, default=2000)
+
+    g = p.add_argument_group("training")
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--train-iters", type=int, default=100)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--optimizer", default="adam",
+                   choices=["adam", "lamb", "sgd", "novograd", "adagrad"])
+    g.add_argument("--recompute-activations", action="store_true")
+
+    ns = p.parse_args(args)
+    if ns.global_batch_size is None:
+        ns.global_batch_size = ns.micro_batch_size
+    if ns.fp16 and ns.bf16:
+        raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    return ns

@@ -1,0 +1,42 @@
+"""Distributed-test helpers (reference: apex/transformer/testing/commons.py
+``initialize_distributed``, ``set_random_seed``, toy models).
+
+The reference's helper spins up torch.distributed + NCCL per test process;
+here tests run single-process over a virtual device mesh, so
+``initialize_distributed`` builds that mesh (real collectives, one process —
+SURVEY.md §4's testing conclusion).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def initialize_distributed(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    n_devices: Optional[int] = None,
+    **kwargs,
+):
+    """Build the test mesh over all (or ``n_devices``) local devices — the
+    per-test ``initialize_distributed`` + ``initialize_model_parallel`` pair
+    (commons.py:30-60)."""
+    n = n_devices or len(jax.devices())
+    return mesh_lib.make_virtual_mesh(
+        n,
+        tensor_model_parallel_size=tensor_model_parallel_size,
+        pipeline_model_parallel_size=pipeline_model_parallel_size,
+        context_parallel_size=context_parallel_size,
+        **kwargs,
+    )
+
+
+def set_random_seed(seed: int) -> jax.Array:
+    """Seed → PRNG key (commons.py set_random_seed; with key-based PRNG the
+    tracker machinery of tensor_parallel/random.py reduces to key folding)."""
+    return jax.random.PRNGKey(seed)

@@ -1,0 +1,21 @@
+"""Global-args singleton (reference: apex/transformer/testing/global_vars.py
+``get_args``/``set_global_variables``). Test-harness only — library code
+takes explicit configs (SURVEY.md §5 config idioms)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+_GLOBAL_ARGS: Optional[argparse.Namespace] = None
+
+
+def set_args(args: argparse.Namespace) -> None:
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = args
+
+
+def get_args() -> argparse.Namespace:
+    if _GLOBAL_ARGS is None:
+        raise RuntimeError("global args not initialized; call set_args first")
+    return _GLOBAL_ARGS

@@ -1,0 +1,153 @@
+"""Inventory-parity tests: amp function registries, FastLayerNorm, FMHA
+varlen, Reducer, transformer.testing harness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp, precision
+from apex_tpu.amp.functions import (
+    float_function,
+    half_function,
+    promote_function,
+    set_active_policy,
+)
+from apex_tpu.contrib.fmha import fmha, fmha_reference
+from apex_tpu.contrib.layer_norm import FastLayerNorm
+from apex_tpu.ops.layer_norm import layer_norm_reference
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.parallel.distributed import Reducer
+from apex_tpu.transformer.testing import (
+    get_args,
+    initialize_distributed,
+    parse_args,
+    set_args,
+    set_random_seed,
+)
+
+
+# -- amp function registries -------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _reset_policy():
+    yield
+    set_active_policy(None)
+
+
+def test_half_float_promote_functions():
+    set_active_policy(precision.get_policy("O1"))
+
+    @half_function
+    def matmul_like(a, b):
+        assert a.dtype == jnp.bfloat16 and b.dtype == jnp.bfloat16
+        return a @ b
+
+    @float_function
+    def loss_like(x):
+        assert x.dtype == jnp.float32
+        return jnp.mean(x)
+
+    @promote_function
+    def add_like(a, b):
+        assert a.dtype == b.dtype == jnp.float32
+        return a + b
+
+    a = jnp.ones((4, 4), jnp.float32)
+    b = jnp.ones((4, 4), jnp.bfloat16)
+    assert matmul_like(a, a).dtype == jnp.bfloat16
+    assert loss_like(b).dtype == jnp.float32
+    assert add_like(a, b).dtype == jnp.float32
+
+
+def test_functions_noop_without_policy():
+    @half_function
+    def f(a):
+        return a
+
+    x = jnp.ones((2,), jnp.float32)
+    assert f(x).dtype == jnp.float32  # no active policy: untouched
+
+
+# -- FastLayerNorm -----------------------------------------------------------
+
+def test_fast_layer_norm_matches_reference():
+    ln = FastLayerNorm(256)
+    params = ln.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 256))
+    np.testing.assert_allclose(
+        np.asarray(ln.apply(params, x)),
+        np.asarray(layer_norm_reference(x, params["weight"], params["bias"])),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_fast_layer_norm_envelope_validation():
+    with pytest.raises(ValueError):
+        FastLayerNorm(250)  # not a multiple of 8
+    with pytest.raises(ValueError):
+        FastLayerNorm(65544)
+
+
+# -- FMHA varlen -------------------------------------------------------------
+
+def test_fmha_varlen_matches_reference():
+    h, d = 2, 16
+    lengths = [5, 9, 3]
+    cu = jnp.asarray(np.cumsum([0] + lengths))
+    total = int(cu[-1])
+    qkv = jax.random.normal(jax.random.PRNGKey(0), (total, 3, h, d))
+    out = fmha(qkv, cu, max_seqlen=16)
+    ref = fmha_reference(qkv, cu)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fmha_causal():
+    h, d = 1, 8
+    cu = jnp.asarray([0, 6])
+    qkv = jax.random.normal(jax.random.PRNGKey(0), (6, 3, h, d))
+    out = fmha(qkv, cu, max_seqlen=8, causal=True)
+    ref = fmha_reference(qkv, cu, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+# -- Reducer -----------------------------------------------------------------
+
+def test_reducer_manual_averaging():
+    mesh = mesh_lib.make_virtual_mesh(4)
+    try:
+        red = Reducer(mesh_lib.AXIS_DATA)
+
+        def fn(x):
+            return red.reduce(x)
+
+        x = jnp.arange(8.0)  # shards [0,1] [2,3] [4,5] [6,7]
+        out = jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=P(mesh_lib.AXIS_DATA), out_specs=P(mesh_lib.AXIS_DATA),
+            check_vma=False))(x)
+        # each shard becomes the mean over shards: [(0+2+4+6)/4, (1+3+5+7)/4]*4
+        np.testing.assert_allclose(np.asarray(out), [3, 4] * 4)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+# -- transformer.testing harness --------------------------------------------
+
+def test_arguments_and_global_vars():
+    args = parse_args(["--hidden-size", "512", "--bf16",
+                       "--tensor-model-parallel-size", "2"])
+    assert args.hidden_size == 512 and args.bf16
+    set_args(args)
+    assert get_args().tensor_model_parallel_size == 2
+    with pytest.raises(ValueError):
+        parse_args(["--fp16", "--bf16"])
+
+
+def test_commons_initialize_distributed():
+    mesh = initialize_distributed(tensor_model_parallel_size=2)
+    try:
+        assert mesh_lib.get_tensor_model_parallel_world_size() == 2
+        key = set_random_seed(1234)
+        assert key.shape == (2,)
+    finally:
+        mesh_lib.destroy_model_parallel()
