@@ -32,7 +32,8 @@ def set_active_policy(policy: Optional[_precision.Policy]) -> None:
 
 def _cast_floats(args, kwargs, dtype):
     def _c(a):
-        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact):
+        # real floating only — casting complex would drop imaginary parts
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
             return a.astype(dtype)
         return a
 
@@ -46,7 +47,14 @@ def half_function(fn: Callable) -> Callable:
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         p = _active_policy
-        if p is None or p.compute_dtype == jnp.float32:
+        # Active only for uncast-model policies (O1): with a cast model
+        # (O2/O3) the wrapper is a no-op so deliberately-fp32 leaves (e.g.
+        # keep_batchnorm_fp32 params) pass through untouched.
+        if (
+            p is None
+            or p.cast_model_type is not None
+            or p.compute_dtype == jnp.float32
+        ):
             return fn(*args, **kwargs)
         args, kwargs = _cast_floats(args, kwargs, p.compute_dtype)
         return fn(*args, **kwargs)

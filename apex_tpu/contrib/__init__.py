@@ -1,8 +1,9 @@
 """apex_tpu.contrib — TPU-native counterparts of apex/contrib.
 
-Implemented: multihead_attn (fused self/enc-dec MHA ± norm-add),
-xentropy + fmha live in apex_tpu.ops (flash_attention subsumes fmhalib;
-softmax_cross_entropy subsumes xentropy_cuda), sparsity (ASP 2:4),
-transducer; groupbn's NHWC BN maps to
-apex_tpu.parallel.SyncBatchNorm(channel_last=True).
+Implemented here: multihead_attn (fused self/enc-dec MHA ± norm-add),
+fmha (packed cu_seqlens varlen attention over the flash kernel),
+layer_norm (FastLayerNorm), sparsity (ASP 2:4), transducer (RNN-T).
+Elsewhere: xentropy lives in apex_tpu.ops.xentropy; groupbn's NHWC BN maps
+to apex_tpu.parallel.SyncBatchNorm(channel_last=True); the distributed
+(ZeRO) optimizers live in apex_tpu.optimizers.distributed.
 """

@@ -35,6 +35,11 @@ def fmha(
       cu_seqlens: ``(batch+1,)`` cumulative sequence boundaries
         (``cu_seqlens[i]``..``cu_seqlens[i+1]`` is sequence ``i``).
       max_seqlen: pad target (static; the reference buckets {128,256,384,512}).
+        Every sequence must fit: with concrete ``cu_seqlens`` this is
+        enforced here; under ``jit`` (traced boundaries) the caller owns the
+        guarantee — like the reference's static bucket dispatch — because a
+        longer sequence cannot be detected at trace time and its tail tokens
+        would be excluded from attention.
 
     Returns packed ``(total_tokens, heads, head_dim)`` context.
     """

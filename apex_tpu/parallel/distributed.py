@@ -14,9 +14,10 @@ overlaps it with the backward automatically. What must be preserved are the
 - ``gradient_predivide_factor``: divide by a factor before the reduce and by
   ``world/factor`` after, to keep fp16 sums in range (:167-175, 452-457).
 
-The ``Reducer`` manual variant (:89-126) maps to calling
-``allreduce_gradients`` yourself; ``delay_allreduce`` and bucket knobs are
-compile-time no-ops here and intentionally absent.
+The ``Reducer`` manual variant (:89-126) is the :class:`Reducer` class below
+(a thin named wrapper over ``allreduce_gradients`` for custom reduction
+timing); ``delay_allreduce`` and bucket knobs are compile-time no-ops here
+and intentionally absent.
 """
 
 from __future__ import annotations

@@ -17,6 +17,7 @@ sharding constraints under ``pjit``.
                           the ``context`` axis (new vs the reference)
 """
 
+from apex_tpu.transformer import amp  # noqa: F401
 from apex_tpu.transformer import data  # noqa: F401
 from apex_tpu.transformer import parallel_state  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401

@@ -1,0 +1,53 @@
+"""Model-parallel-aware grad scaling
+(reference: apex/transformer/amp/grad_scaler.py:8-106 ``GradScaler``).
+
+The reference subclasses torch's GradScaler to all-reduce ``found_inf``
+across the **model-parallel group** in ``_maybe_opt_step`` (:25-36) and
+``update`` (:80-94) so every TP/PP rank takes the same skip decision.
+
+Here the scaler state machine lives in :class:`apex_tpu.amp.LossScaler`;
+the model-parallel reduction plugs into
+``MixedPrecisionOptimizer.apply_gradients(found_inf_reducer=...)``.
+:class:`MeshGradScaler` packages that reducer for the current mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import AXIS_MODEL, AXIS_PIPE
+
+AxisNames = Union[str, Tuple[str, ...]]
+
+
+def model_parallel_found_inf_reducer(
+    axes: AxisNames = (AXIS_MODEL, AXIS_PIPE),
+):
+    """found_inf OR-reduction over the model-parallel axes — apply inside
+    ``shard_map`` (grad_scaler.py:25-36: ``all_reduce(found_inf, MAX,
+    model_parallel_group)``)."""
+    axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+
+    def reduce(found_inf: jax.Array) -> jax.Array:
+        return lax.pmax(found_inf.astype(jnp.float32), axes_t) > 0
+
+    return reduce
+
+
+class MeshGradScaler:
+    """Convenience bundle: pass ``scaler.found_inf_reducer`` into
+    ``MixedPrecisionOptimizer.apply_gradients`` when training under a mesh
+    with model-parallel axes.
+
+    >>> scaler = MeshGradScaler()                     # ('model', 'pipe')
+    >>> mp_opt.apply_gradients(state, params, grads,
+    ...                        found_inf_reducer=scaler.found_inf_reducer)
+    """
+
+    def __init__(self, axes: AxisNames = (AXIS_MODEL, AXIS_PIPE)):
+        self.axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        self.found_inf_reducer = model_parallel_found_inf_reducer(self.axes)

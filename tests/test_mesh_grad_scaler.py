@@ -1,0 +1,75 @@
+"""Model-parallel grad-scaler tests (reference:
+apex/transformer/amp/grad_scaler.py — all TP/PP ranks must take the same
+skip decision when any rank overflows)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.transformer.amp import MeshGradScaler
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    if mesh_lib.model_parallel_is_initialized():
+        mesh_lib.destroy_model_parallel()
+
+
+def _run(found_inf_reducer, axis=mesh_lib.AXIS_MODEL):
+    """One O2 step sharded 4-way over ``axis`` with inf only in rank 1's grad
+    shard. Opt state is built inside the sharded region so masters/momentum
+    match shard shapes."""
+    kw = {"tensor_model_parallel_size": 4} if axis == mesh_lib.AXIS_MODEL else {
+        "pipeline_model_parallel_size": 4}
+    mesh = mesh_lib.make_virtual_mesh(4, **kw)
+    policy = amp.get_policy("O2")
+    mp_opt = amp.MixedPrecisionOptimizer(FusedSGD(lr=0.1), policy)
+
+    params = {"w": jnp.ones((8,), jnp.bfloat16)}
+    # grads sized like the 2^16-scaled loss so the unscaled update is visible
+    # at bf16 resolution
+    grads = {"w": jnp.full((8,), 2.0 ** 15, jnp.bfloat16).at[3].set(jnp.inf)}
+    spec = {"w": P(axis)}
+
+    def step(params, grads):
+        opt_state = mp_opt.init(params)
+        new_params, new_state, metrics = mp_opt.apply_gradients(
+            opt_state, params, grads, found_inf_reducer=found_inf_reducer)
+        return new_params, metrics["found_inf"], new_state.scaler.loss_scale
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(spec, spec), out_specs=(spec, P(), P()),
+        check_vma=False))
+    sharded = jax.device_put(params, {"w": NamedSharding(mesh, spec["w"])})
+    new_params, found_inf, new_scale = fn(sharded, grads)
+    return (np.asarray(new_params["w"], np.float32), bool(found_inf),
+            float(new_scale))
+
+
+@pytest.mark.parametrize("axis", [mesh_lib.AXIS_MODEL, mesh_lib.AXIS_PIPE])
+def test_one_rank_overflow_skips_all_ranks(axis):
+    """Covers both model-parallel axes the reference's GradScaler reduces
+    over (TP here, and the pipe axis used by pipelined O2 recipes)."""
+    scaler = MeshGradScaler(axis)
+    w, found_inf, new_scale = _run(scaler.found_inf_reducer, axis)
+    assert found_inf
+    # every shard skipped: params unchanged on ALL ranks, incl. finite ones
+    np.testing.assert_array_equal(w, np.ones(8, np.float32))
+    assert new_scale == 2.0 ** 15  # halved everywhere
+
+
+def test_without_reducer_ranks_diverge():
+    """Control: without the mesh reduction only the overflowing rank skips —
+    exactly the hazard the reference's GradScaler subclass exists to
+    prevent (and the reported found_inf is rank-local)."""
+    w, _, _ = _run(None)
+    # rank 1's slice (elements 2:4) skipped; the other ranks stepped
+    assert np.all(w[2:4] == 1.0)
+    assert np.all(w[:2] != 1.0) and np.all(w[4:] != 1.0)

@@ -167,3 +167,77 @@ def test_microbatch_calculators():
     assert r.get() == 8
     with pytest.raises(ValueError):
         build_num_microbatches_calculator(63, 4, 2)
+
+
+def test_pipeline_o2_with_mesh_grad_scaler():
+    """The dtype x grad-scaler leg of the reference sweep
+    (run_pipeline_parallel_test.py:33-80): bf16 O2 pipelined step matches
+    the serial O2 loss and the scaler stays on its clean-step schedule.
+    (Uniform cross-stage skip is covered by test_mesh_grad_scaler.py on both
+    the model and pipe axes.)"""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+
+    cfg = dict(TINY)
+    cfg["compute_dtype"] = jnp.bfloat16
+    mesh = mesh_lib.make_virtual_mesh(2, pipeline_model_parallel_size=2)
+    try:
+        serial = GPTModel(GPTConfig(axis=None, **cfg))
+        par = GPTModel(GPTConfig(axis=None, **cfg))
+        policy = amp.get_policy("O2")
+        mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-3), policy)
+        params = amp.cast_params(serial.init(jax.random.PRNGKey(0)), policy)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+        tgt = jnp.roll(toks, -1, axis=-1)
+
+        # serial O2 reference loss
+        v_s = float(serial.loss(params, toks, tgt))
+
+        specs = par.specs()
+        layer_specs = pipeline_specs(specs["layers"])
+        rest_specs = {k: v for k, v in specs.items() if k != "layers"}
+        all_specs = dict(rest_specs, layers=layer_specs)
+        sharded = tp.shard_params(params, all_specs, mesh)
+        opt_state = mp_opt.init(sharded)
+
+        loss_fn = pipelined_loss_fn(
+            embed=par.embed,
+            run_layers=lambda lp, h: par.run_layers(lp, h),
+            head_loss=lambda p, h, t: par.head(p, h, t),
+            num_microbatches=4,
+        )
+
+        def sharded_grads(p, toks, tgt, scale):
+            rest = {k: v for k, v in p.items() if k != "layers"}
+
+            def scaled(rest, layers):
+                return loss_fn(rest, layers, toks, tgt) * scale
+
+            loss, (rg, lg) = jax.value_and_grad(scaled, argnums=(0, 1))(
+                rest, p["layers"])
+            rg = allreduce_gradients_by_spec(rg, rest_specs)
+            return jax.lax.pmean(loss, "pipe"), dict(rg, layers=lg)
+
+        shard_fn = jax.shard_map(
+            sharded_grads, mesh=mesh,
+            in_specs=(all_specs, P(), P(), P()),
+            out_specs=(P(), all_specs), check_vma=False)
+
+        @jax.jit
+        def train_step(params, opt_state, toks, tgt):
+            sl, sg = shard_fn(params, toks, tgt, opt_state.scaler.loss_scale)
+            np_, ns, m = mp_opt.apply_gradients(opt_state, params, sg)
+            return np_, ns, sl / opt_state.scaler.loss_scale, m
+
+        new_params, new_state, loss, metrics = train_step(
+            sharded, opt_state, toks, tgt)
+        np.testing.assert_allclose(float(loss), v_s, rtol=2e-5)
+        assert not bool(metrics["found_inf"])
+        assert float(new_state.scaler.loss_scale) == 2.0 ** 16
+        # params actually moved
+        delta = jnp.abs(
+            new_params["position"].astype(jnp.float32)
+            - jax.device_get(sharded["position"]).astype(jnp.float32)).max()
+        assert float(delta) > 0
+    finally:
+        mesh_lib.destroy_model_parallel()
