@@ -1,0 +1,89 @@
+"""DDP semantics against analytic gradients
+(reference: tests/distributed/DDP/ddp_race_condition_test.py:28-60 — a
+``loss = sum(a * b * x)`` model whose gradients are known in closed form,
+checked under aggressive bucketing/stream settings).
+
+The race surface (buckets/streams) does not exist under jit, but the
+*semantic* contract the test pins down — every rank's grad equals the
+average of the closed-form per-rank grads, for every option combination —
+is exactly what allreduce_gradients must guarantee.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.parallel.distributed import DistributedDataParallel, allreduce_gradients
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    if mesh_lib.model_parallel_is_initialized():
+        mesh_lib.destroy_model_parallel()
+
+
+def _setup():
+    mesh = mesh_lib.make_virtual_mesh(4)
+    # params replicated; per-rank inputs x differ => grads differ per rank
+    params = {"a": jnp.arange(1.0, 4.0), "b": jnp.asarray([2.0, -1.0, 0.5])}
+    x = jnp.arange(8.0).reshape(4, 2, 1) + 1.0  # (ranks*2, 1) sharded rows
+    return mesh, params, x.reshape(8, 1)
+
+
+def _analytic_avg_grads(params, x):
+    # loss_r = sum_i sum_j a_i * b_i * x_rj  => da_i = b_i * sum(x_r), etc.
+    sum_x_per_rank = np.asarray(x).reshape(4, 2).sum(axis=1)
+    mean_sum_x = sum_x_per_rank.mean()
+    return {
+        "a": np.asarray(params["b"]) * mean_sum_x,
+        "b": np.asarray(params["a"]) * mean_sum_x,
+    }
+
+
+@pytest.mark.parametrize("fp32,predivide", [(False, 1.0), (True, 1.0),
+                                            (False, 2.0), (True, 4.0)])
+def test_grads_match_closed_form(fp32, predivide):
+    mesh, params, x = _setup()
+
+    def loss_fn(p, x):
+        return jnp.sum(p["a"] * p["b"] * jnp.sum(x))
+
+    ddp = DistributedDataParallel(
+        loss_fn, axes=mesh_lib.AXIS_DATA,
+        allreduce_always_fp32=fp32, gradient_predivide_factor=predivide)
+
+    fn = jax.jit(jax.shard_map(
+        lambda p, x: ddp.value_and_grad(p, x)[1], mesh=mesh,
+        in_specs=(P(), P(mesh_lib.AXIS_DATA)), out_specs=P(),
+        check_vma=False))
+    grads = fn(params, x)
+    expect = _analytic_avg_grads(params, x)
+    np.testing.assert_allclose(np.asarray(grads["a"]), expect["a"], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["b"]), expect["b"], rtol=1e-5)
+
+
+def test_bf16_grads_reduce_in_fp32_when_asked():
+    """allreduce_always_fp32 upcasts before the sum: with values whose bf16
+    sum loses bits, the fp32 reduction must match the exact average while
+    preserving the grad dtype (distributed.py:52-58 dtype-split buckets)."""
+    mesh = mesh_lib.make_virtual_mesh(4)
+    # per-rank grads: 256.0 and three 1.0's — bf16 256+1 rounds to 258/4?
+    # (256.+1. = 257 -> bf16 rounds to 256; fp32 keeps 257)
+    g = jnp.asarray([256.0, 1.0, 1.0, 1.0], jnp.bfloat16)
+
+    def reduce(g, fp32):
+        return allreduce_gradients(
+            {"g": g}, mesh_lib.AXIS_DATA, allreduce_always_fp32=fp32)["g"]
+
+    out32 = jax.jit(jax.shard_map(
+        lambda g: reduce(g, True), mesh=mesh,
+        in_specs=P(mesh_lib.AXIS_DATA), out_specs=P(mesh_lib.AXIS_DATA),
+        check_vma=False))(g)
+    assert out32.dtype == jnp.bfloat16  # dtype restored after fp32 math
+    # exact mean 259/4 = 64.75 -> nearest bf16 is 64.5/65? 64.75 rounds to 64.5
+    np.testing.assert_allclose(
+        np.asarray(out32, np.float32), np.full(4, np.float32(jnp.bfloat16(259 / 4))))

@@ -1,0 +1,88 @@
+"""L1-tier convergence tests (reference: tests/L1/common/run_test.sh:30-80 —
+ResNet runs swept over {O0..O3} x {loss-scale variants} x
+{keep_batchnorm_fp32}, compared against a stored baseline).
+
+The reference compares bitwise against a recorded run; XLA rewrites make
+bitwise brittle (SURVEY.md §7 hard parts), so the contract here is
+*convergence equivalence*: every opt-level/scale configuration must reach
+(close to) the fp32 baseline's loss on the same fixed data and seed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.models.resnet import BasicBlock, ResNet
+from apex_tpu.ops.xentropy import softmax_cross_entropy
+from apex_tpu.optimizers import FusedSGD
+
+
+def tiny_resnet(dtype):
+    return ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=4,
+                  width=8, stem_pool=False, dtype=dtype)
+
+
+def _fixed_data():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    images = jax.random.normal(k1, (16, 8, 8, 3))
+    labels = jax.random.randint(k2, (16,), 0, 4)
+    return images, labels
+
+
+def _train(opt_level, steps=30, **overrides):
+    policy = amp.get_policy(opt_level, **overrides)
+    model = tiny_resnet(policy.op_dtype("conv"))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedSGD(lr=0.05, momentum=0.9), policy)
+    images, labels = _fixed_data()
+    variables = model.init(jax.random.PRNGKey(0), images[:1])
+    params = amp.cast_params(variables["params"], policy)
+    stats = variables["batch_stats"]
+    state = mp_opt.init(params)
+
+    @jax.jit
+    def step(p, st, s):
+        def scaled(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": st}, images, mutable=["batch_stats"])
+            loss = jnp.mean(softmax_cross_entropy(logits, labels))
+            return mp_opt.scale_loss(loss, s), mutated["batch_stats"]
+
+        (ls, new_st), gs = jax.value_and_grad(scaled, has_aux=True)(p)
+        np_, ns, m = mp_opt.apply_gradients(s, p, gs)
+        return np_, new_st, ns, ls / s.scaler.loss_scale
+
+    first = None
+    for _ in range(steps):
+        params, stats, state, loss = step(params, stats, state)
+        first = first if first is not None else float(loss)
+    return first, float(loss)
+
+
+# the L1 sweep axes that are meaningful on TPU (fp16-era loss-scale values
+# map onto the dynamic/static scaler knobs)
+CONFIGS = [
+    ("O0", {}),
+    ("O1", {}),
+    ("O2", {}),
+    ("O2", {"loss_scale": 128.0}),
+    ("O2", {"keep_batchnorm_fp32": False}),
+    ("O3", {}),
+]
+
+
+@pytest.mark.parametrize("opt_level,overrides", CONFIGS)
+def test_cross_product_converges(opt_level, overrides):
+    first, last = _train(opt_level, **overrides)
+    assert np.isfinite(last)
+    assert last < first * 0.5, f"{opt_level} {overrides}: {first} -> {last}"
+
+
+def test_mixed_precision_matches_fp32_baseline():
+    """The compare.py contract, tolerance-based: O2's final loss tracks the
+    O0 baseline on identical data/seed."""
+    _, base = _train("O0")
+    _, o2 = _train("O2")
+    assert abs(o2 - base) < max(0.15, 0.35 * abs(base)), (base, o2)
