@@ -71,19 +71,24 @@ def test_bf16_grads_reduce_in_fp32_when_asked():
     sum loses bits, the fp32 reduction must match the exact average while
     preserving the grad dtype (distributed.py:52-58 dtype-split buckets)."""
     mesh = mesh_lib.make_virtual_mesh(4)
-    # per-rank grads: 256.0 and three 1.0's — bf16 256+1 rounds to 258/4?
-    # (256.+1. = 257 -> bf16 rounds to 256; fp32 keeps 257)
+    # per-rank grads 256, 1, 1, 1: summed in bf16, each 256+1 rounds back to
+    # 256 (bf16 has 8 mantissa bits), so the bf16-sum mean is 64; summed in
+    # fp32 the exact mean is 259/4 = 64.75.
     g = jnp.asarray([256.0, 1.0, 1.0, 1.0], jnp.bfloat16)
 
-    def reduce(g, fp32):
-        return allreduce_gradients(
-            {"g": g}, mesh_lib.AXIS_DATA, allreduce_always_fp32=fp32)["g"]
+    def run(fp32):
+        return jax.jit(jax.shard_map(
+            lambda g: allreduce_gradients(
+                {"g": g}, mesh_lib.AXIS_DATA, allreduce_always_fp32=fp32)["g"],
+            mesh=mesh,
+            in_specs=P(mesh_lib.AXIS_DATA), out_specs=P(mesh_lib.AXIS_DATA),
+            check_vma=False))(g)
 
-    out32 = jax.jit(jax.shard_map(
-        lambda g: reduce(g, True), mesh=mesh,
-        in_specs=P(mesh_lib.AXIS_DATA), out_specs=P(mesh_lib.AXIS_DATA),
-        check_vma=False))(g)
+    out32 = run(True)
     assert out32.dtype == jnp.bfloat16  # dtype restored after fp32 math
-    # exact mean 259/4 = 64.75 -> nearest bf16 is 64.5/65? 64.75 rounds to 64.5
     np.testing.assert_allclose(
-        np.asarray(out32, np.float32), np.full(4, np.float32(jnp.bfloat16(259 / 4))))
+        np.asarray(out32, np.float32),
+        np.full(4, np.float32(jnp.bfloat16(64.75))))
+    # contrast: the bf16-summed path absorbs the small grads entirely
+    out16 = run(False)
+    np.testing.assert_allclose(np.asarray(out16, np.float32), np.full(4, 64.0))

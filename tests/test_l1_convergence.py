@@ -31,7 +31,19 @@ def _fixed_data():
     return images, labels
 
 
+_TRAIN_CACHE = {}
+
+
 def _train(opt_level, steps=30, **overrides):
+    key = (opt_level, steps, tuple(sorted(overrides.items())))
+    if key in _TRAIN_CACHE:
+        return _TRAIN_CACHE[key]
+    result = _train_uncached(opt_level, steps, **overrides)
+    _TRAIN_CACHE[key] = result
+    return result
+
+
+def _train_uncached(opt_level, steps, **overrides):
     policy = amp.get_policy(opt_level, **overrides)
     model = tiny_resnet(policy.op_dtype("conv"))
     mp_opt = amp.MixedPrecisionOptimizer(
