@@ -67,32 +67,24 @@ def test_grads_match_closed_form(fp32, predivide):
 
 
 def test_bf16_grads_reduce_in_fp32_when_asked():
-    """allreduce_always_fp32 upcasts before the sum: with values whose bf16
-    sum loses bits, the fp32 reduction must match the exact average while
-    preserving the grad dtype (distributed.py:52-58 dtype-split buckets)."""
+    """allreduce_always_fp32 upcasts before the sum and restores the grad
+    dtype after (distributed.py:52-58 dtype-split buckets). The fp32 path's
+    mean must equal the exact average rounded once to bf16.
+
+    (A numeric contrast against the non-upcast path is not asserted: XLA is
+    free to — and on CPU does — accumulate bf16 psums in wider precision, so
+    the two paths coincide there; the option's guarantee is that the math is
+    fp32 *by contract* rather than by backend accident.)"""
     mesh = mesh_lib.make_virtual_mesh(4)
-    # per-rank grads 256, 1, 1, 1: summed in bf16, each 256+1 rounds back to
-    # 256 (bf16 has 8 mantissa bits), so the bf16-sum mean is 64; summed in
-    # fp32 the exact mean is 259/4 = 64.75.
     g = jnp.asarray([256.0, 1.0, 1.0, 1.0], jnp.bfloat16)
 
-    def run(fp32):
-        return jax.jit(jax.shard_map(
-            lambda g: allreduce_gradients(
-                {"g": g}, mesh_lib.AXIS_DATA, allreduce_always_fp32=fp32)["g"],
-            mesh=mesh,
-            in_specs=P(mesh_lib.AXIS_DATA), out_specs=P(mesh_lib.AXIS_DATA),
-            check_vma=False))(g)
-
-    out32 = run(True)
+    out32 = jax.jit(jax.shard_map(
+        lambda g: allreduce_gradients(
+            {"g": g}, mesh_lib.AXIS_DATA, allreduce_always_fp32=True)["g"],
+        mesh=mesh,
+        in_specs=P(mesh_lib.AXIS_DATA), out_specs=P(mesh_lib.AXIS_DATA),
+        check_vma=False))(g)
     assert out32.dtype == jnp.bfloat16  # dtype restored after fp32 math
     np.testing.assert_allclose(
         np.asarray(out32, np.float32),
-        np.full(4, np.float32(jnp.bfloat16(64.75))))
-    # contrast: the bf16-summed path cannot represent the exact sum 259
-    # (bf16 spacing at 2^8 is 2), so its mean differs from the fp32 path's.
-    # The exact rounded value is backend-dependent (sequential bf16 adds
-    # give 256 -> mean 64; one wide accumulation rounds 259 -> 260 -> 65),
-    # so assert the divergence, not a specific artifact.
-    out16 = run(False)
-    assert float(out16[0]) != float(out32[0])
+        np.full(4, np.float32(jnp.bfloat16(259.0 / 4))))
