@@ -62,6 +62,10 @@ class GPTConfig:
     init_method_std: float = 0.02
     remat: bool = True  # activation checkpointing per layer
     attention_impl: str = "auto"  # flash_attention impl switch
+    # chunked fused LM-head CE (ops/lm_head_loss): avoids materializing the
+    # (tokens, vocab) logits when computing the loss. Serial (axis=None) only;
+    # under TP the vocab is already sharded V/tp ways.
+    lm_head_chunks: Optional[int] = None
 
     @property
     def ffn(self) -> int:
@@ -134,6 +138,11 @@ class GPTModel(TransformerBase):
         (post_language_model_processing, standalone_gpt.py:1361+)."""
         c = self.cfg
         h = self._ln(params["ln_f"], h)
+        if c.axis is None and c.lm_head_chunks and targets is not None:
+            from apex_tpu.ops.lm_head_loss import lm_head_cross_entropy
+
+            return lm_head_cross_entropy(
+                h, params["embedding"]["embedding"], targets, c.lm_head_chunks)
         wte = params["embedding"]["embedding"].astype(h.dtype)  # (V/tp, H)
         if c.axis is not None:
             h = tp.copy_to_tensor_model_parallel_region(h, c.axis)

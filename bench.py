@@ -48,6 +48,9 @@ def build(policy_level: str, impl: str):
         compute_dtype=jnp.bfloat16 if fused else jnp.float32,
         remat=True,
         attention_impl=impl,
+        # fused chunked LM-head CE: same throughput, ~0.8 GB less peak HBM
+        # (survives pressure from co-tenants on the shared chip)
+        lm_head_chunks=8 if fused else None,
     )
     model = GPTModel(cfg)
     policy = amp.get_policy(policy_level)
