@@ -48,8 +48,8 @@ def build(policy_level: str, impl: str):
         compute_dtype=jnp.bfloat16 if fused else jnp.float32,
         remat=True,
         attention_impl=impl,
-        # fused chunked LM-head CE: same throughput, ~0.8 GB less peak HBM
-        # (survives pressure from co-tenants on the shared chip)
+        # fused chunked LM-head CE: ~6% throughput and ~0.8 GB less peak HBM
+        # (survives pressure from co-tenants on the shared chip) — PERF_NOTES.md
         lm_head_chunks=8 if fused else None,
     )
     model = GPTModel(cfg)
@@ -92,23 +92,41 @@ def measure(train_step, params, opt_state, batch, seq, steps=10) -> float:
     return batch * seq / dt
 
 
+def measure_resilient(level, impl, batch, seq, steps):
+    """The chip is shared: co-tenant HBM pressure can OOM a config that
+    normally fits. Halve the batch (tokens/s is per-token normalized) rather
+    than lose the round's record."""
+    while True:
+        try:
+            return measure(*build(level, impl), batch, seq, steps), batch
+        except Exception as e:  # noqa: BLE001 - jaxlib error types vary
+            if "RESOURCE_EXHAUSTED" not in str(e) or batch <= 1:
+                raise
+            batch //= 2
+            print(f"{level}: OOM, retrying at batch {batch}", file=sys.stderr)
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = 1024
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     print(f"platform: {jax.default_backend()}", file=sys.stderr)
 
-    fused_tps = measure(*build("O2", "auto"), batch, seq, steps)
-    print(f"O2+fused: {fused_tps:.0f} tokens/s", file=sys.stderr)
-    base_tps = measure(*build("O0", "xla"), batch, seq, steps)
-    print(f"O0 fp32 unfused: {base_tps:.0f} tokens/s", file=sys.stderr)
+    fused_tps, fused_batch = measure_resilient("O2", "auto", batch, seq, steps)
+    print(f"O2+fused: {fused_tps:.0f} tokens/s (batch {fused_batch})", file=sys.stderr)
+    base_tps, base_batch = measure_resilient("O0", "xla", batch, seq, steps)
+    print(f"O0 fp32 unfused: {base_tps:.0f} tokens/s (batch {base_batch})", file=sys.stderr)
 
-    print(json.dumps({
+    result = {
         "metric": "gpt2_345m_o2_train_tokens_per_sec",
         "value": round(fused_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(fused_tps / base_tps, 3),
-    }))
+    }
+    if fused_batch != batch or base_batch != batch:
+        # record the actually-measured config when OOM retries shrank it
+        result["effective_batch"] = {"o2": fused_batch, "o0": base_batch}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
