@@ -12,6 +12,7 @@ from apex_tpu.amp.frontend import (  # noqa: F401
     initialize,
 )
 from apex_tpu.amp.functions import (  # noqa: F401
+    disable_casts,
     float_function,
     half_function,
     promote_function,

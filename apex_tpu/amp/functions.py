@@ -30,6 +30,23 @@ def set_active_policy(policy: Optional[_precision.Policy]) -> None:
     _active_policy = policy
 
 
+class disable_casts:
+    """Context manager suspending the registered-function casts
+    (``amp.disable_casts``, apex/amp/handle.py:163-167 — e.g. around an op
+    that must see its inputs untouched)."""
+
+    def __enter__(self):
+        global _active_policy
+        self._saved = _active_policy
+        _active_policy = None
+        return self
+
+    def __exit__(self, *exc):
+        global _active_policy
+        _active_policy = self._saved
+        return False
+
+
 def _cast_floats(args, kwargs, dtype):
     def _c(a):
         # real floating only — casting complex would drop imaginary parts

@@ -1,0 +1,102 @@
+"""BERT pretraining with FusedLAMB + fused LayerNorm (BASELINE.md config 3).
+
+Reference workload: BERT-large MLM+NSP pretraining with apex FusedLAMB and
+FusedLayerNorm (the apex README's flagship BERT recipe). Synthetic masked
+batches by default.
+
+    JAX_PLATFORMS=cpu python examples/bert/pretrain_bert.py --steps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.models import BertConfig, BertModel
+from apex_tpu.optimizers import FusedLAMB
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--opt-level", default="O2")
+    return p.parse_args()
+
+
+def synthetic_batch(rng, batch, seq, vocab):
+    toks = rng.integers(0, vocab, (batch, seq))
+    attn = np.ones((batch, seq), np.int32)
+    lmask = (rng.random((batch, seq)) < 0.15).astype(np.int32)
+    labels = rng.integers(0, vocab, (batch, seq))
+    nsp = rng.integers(0, 2, (batch,))
+    types = np.zeros((batch, seq), np.int32)
+    return tuple(jnp.asarray(a) for a in (toks, attn, lmask, labels, nsp, types))
+
+
+def main():
+    args = parse_args()
+    cfg = BertConfig(
+        hidden_size=args.hidden, num_layers=args.layers,
+        num_attention_heads=args.heads, max_seq_len=args.seq,
+        hidden_dropout=0.0, axis=None,
+        compute_dtype=jnp.bfloat16 if args.opt_level != "O0" else jnp.float32,
+        remat=True,
+    )
+    model = BertModel(cfg)
+    policy = amp.get_policy(args.opt_level)
+    # FusedLAMB: the layer-adaptive optimizer the reference pairs with BERT
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedLAMB(lr=args.lr, weight_decay=0.01), policy)
+    params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    state = mp_opt.init(params)
+
+    @jax.jit
+    def train_step(p, s, toks, attn, lmask, labels, nsp, types):
+        def scaled(p):
+            return mp_opt.scale_loss(
+                model.loss(p, toks, attn, lmask, labels, nsp, types), s)
+
+        ls, gs = jax.value_and_grad(scaled)(p)
+        np_, ns, m = mp_opt.apply_gradients(s, p, gs)
+        return np_, ns, ls / s.scaler.loss_scale, m
+
+    if args.steps < 2:
+        raise SystemExit("--steps must be >= 2 (step 0 is compile warmup)")
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        batch = synthetic_batch(rng, args.batch, args.seq, cfg.vocab_size)
+        params, state, loss, metrics = train_step(params, state, *batch)
+        if i == 0:
+            float(loss)
+            t0 = time.perf_counter()
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:4d} mlm+nsp loss {float(loss):.4f} "
+                  f"scale {float(metrics['loss_scale']):.0f}")
+    n = max(args.steps - 1, 1)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{args.batch * args.seq / dt:.0f} tokens/s "
+          f"({args.opt_level}, FusedLAMB, {dt*1e3:.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,3 +151,19 @@ def test_commons_initialize_distributed():
         assert key.shape == (2,)
     finally:
         mesh_lib.destroy_model_parallel()
+
+
+def test_disable_casts_context():
+    from apex_tpu.amp.functions import disable_casts
+
+    set_active_policy(precision.get_policy("O1"))
+
+    @half_function
+    def f(a):
+        return a
+
+    x = jnp.ones((2,), jnp.float32)
+    assert f(x).dtype == jnp.bfloat16
+    with disable_casts():
+        assert f(x).dtype == jnp.float32  # casts suspended
+    assert f(x).dtype == jnp.bfloat16  # restored
