@@ -1,0 +1,50 @@
+"""NHWC BatchNorm (+add+ReLU fusion) — contrib.groupbn surface
+(reference: apex/contrib/groupbn/batch_norm.py, the ``bnp`` extension with
+CUDA-IPC peer reduction).
+
+On TPU, NHWC is the native layout and cross-device reduction is a mesh-axis
+``psum``, so the implementation *is* :class:`apex_tpu.parallel.SyncBatchNorm`
+with ``channel_last=True``; this module keeps the reference's constructor
+surface (``BatchNorm2d_NHWC(planes, fuse_relu=..., bn_group=...)``) so
+migrating code reads the same. The ``add+ReLU`` fusion
+(``batch_norm_add_relu``) is the residual epilogue XLA fuses when you write
+``relu(bn(x) + z)`` — provided here as :func:`batch_norm_add_relu` on the
+module output for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+def BatchNorm2d_NHWC(
+    planes: int,
+    fuse_relu: bool = False,
+    bn_group: int = 1,
+    axis_name: Optional[str] = None,
+    eps: float = 1e-5,
+    momentum: float = 0.1,
+) -> SyncBatchNorm:
+    """Constructor-compatible factory (batch_norm.py:BatchNorm2d_NHWC):
+    ``bn_group > 1`` synchronizes stats over groups of that size on the mesh
+    axis (the CUDA-IPC peer group becomes ``axis_index_groups``)."""
+    return SyncBatchNorm(
+        num_features=planes,
+        eps=eps,
+        momentum=momentum,
+        axis_name=axis_name if bn_group > 1 else None,
+        group_size=bn_group if bn_group > 1 else None,
+        channel_last=True,
+        fuse_relu=fuse_relu,
+    )
+
+
+def batch_norm_add_relu(bn_out: jax.Array, residual: jax.Array) -> jax.Array:
+    """The bn+add+relu epilogue (``bnAddRelu``): one fused XLA region when
+    applied to a (non-relu) BN output."""
+    return jax.nn.relu(bn_out + residual)

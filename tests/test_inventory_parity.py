@@ -167,3 +167,20 @@ def test_disable_casts_context():
     with disable_casts():
         assert f(x).dtype == jnp.float32  # casts suspended
     assert f(x).dtype == jnp.bfloat16  # restored
+
+
+def test_groupbn_nhwc_surface():
+    from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC, batch_norm_add_relu
+
+    bn = BatchNorm2d_NHWC(8, fuse_relu=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 8))
+    variables = bn.init(jax.random.PRNGKey(1), x)
+    y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+    assert y.shape == x.shape
+    assert float(jnp.min(y)) >= 0.0  # fused relu
+    # add+relu epilogue on a plain BN output
+    bn2 = BatchNorm2d_NHWC(8)
+    v2 = bn2.init(jax.random.PRNGKey(1), x)
+    out, _ = bn2.apply(v2, x, mutable=["batch_stats"])
+    z = batch_norm_add_relu(out, -out)
+    np.testing.assert_allclose(np.asarray(z), 0.0, atol=1e-6)
