@@ -32,7 +32,12 @@ def BatchNorm2d_NHWC(
 ) -> SyncBatchNorm:
     """Constructor-compatible factory (batch_norm.py:BatchNorm2d_NHWC):
     ``bn_group > 1`` synchronizes stats over groups of that size on the mesh
-    axis (the CUDA-IPC peer group becomes ``axis_index_groups``)."""
+    axis (the CUDA-IPC peer group becomes ``axis_index_groups``) — which
+    means ``axis_name`` must name the mesh axis to reduce over."""
+    if bn_group > 1 and axis_name is None:
+        raise ValueError(
+            "bn_group > 1 requires axis_name (the mesh axis carrying the "
+            "peer group); without it stats would silently stay device-local")
     return SyncBatchNorm(
         num_features=planes,
         eps=eps,

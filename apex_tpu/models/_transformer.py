@@ -149,10 +149,15 @@ class TransformerBase:
         n_local = qkv.shape[-1] // (3 * c.head_dim)
         qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
-        attn = flash_attention(q, k, v, bias=bias, causal=self.causal,
-                               impl=c.attention_impl)
+        attn = self._attend(q, k, v, bias)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
         return self.proj.apply(p["proj"], attn)
+
+    def _attend(self, q, k, v, bias):
+        """Core attention on (b, nh, s, d) — the override point for
+        sequence-parallel implementations."""
+        return flash_attention(q, k, v, bias=bias, causal=self.causal,
+                               impl=self.cfg.attention_impl)
 
     def _mlp(self, p: Params, h: jax.Array) -> jax.Array:
         return self.fc2.apply(p["fc2"], jax.nn.gelu(self.fc1.apply(p["fc1"], h)))

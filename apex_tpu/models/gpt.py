@@ -66,6 +66,12 @@ class GPTConfig:
     # (tokens, vocab) logits when computing the loss. Serial (axis=None) only;
     # under TP the vocab is already sharded V/tp ways.
     lm_head_chunks: Optional[int] = None
+    # sequence/context parallelism (long-context; NEW vs the reference,
+    # SURVEY.md §2.3 row SP): shard the sequence dim over this mesh axis and
+    # attend with ring attention (ppermute block exchange) or Ulysses
+    # all-to-all. Run under shard_map with tokens sharded on dim 1.
+    context_axis: Optional[str] = None
+    sequence_parallel_impl: str = "ring"  # 'ring' | 'ulysses' 
 
     @property
     def ffn(self) -> int:
@@ -118,8 +124,35 @@ class GPTModel(TransformerBase):
     def embed(self, params: Params, tokens: jax.Array) -> jax.Array:
         c = self.cfg
         h = self.embedding.apply(params["embedding"], tokens)
-        pos = params["position"][: tokens.shape[-1]]
+        s_local = tokens.shape[-1]
+        if c.context_axis is not None:
+            # sequence sharded: this shard's global positions start at
+            # rank * local_seq
+            start = lax.axis_index(c.context_axis) * s_local
+            pos = lax.dynamic_slice_in_dim(
+                params["position"], start, s_local, axis=0)
+        else:
+            pos = params["position"][:s_local]
         return (h + pos).astype(c.compute_dtype)
+
+    def _attend(self, q, k, v, bias):
+        c = self.cfg
+        if c.context_axis is None:
+            return super()._attend(q, k, v, bias)
+        from apex_tpu.transformer.ring import ring_attention, ulysses_attention
+
+        if bias is not None:
+            raise NotImplementedError(
+                "attention bias is not supported under sequence parallelism "
+                "(the ring/Ulysses paths take no bias); run with "
+                "context_axis=None for biased attention")
+        impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+        if c.sequence_parallel_impl not in impls:
+            raise ValueError(
+                f"sequence_parallel_impl must be 'ring' or 'ulysses', "
+                f"got {c.sequence_parallel_impl!r}")
+        return impls[c.sequence_parallel_impl](
+            q, k, v, axis=c.context_axis, causal=True, impl=c.attention_impl)
 
     def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
         """Pre-LN block: residual + sublayer(LN(h))."""
