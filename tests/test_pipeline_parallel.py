@@ -43,14 +43,15 @@ TINY = dict(
 )
 
 
-def _setup(pp, tp_size=1, vpp=1):
+def _setup(pp, tp_size=1, **cfg_overrides):
     mesh = mesh_lib.make_virtual_mesh(
         pp * tp_size, tensor_model_parallel_size=tp_size,
         pipeline_model_parallel_size=pp,
     )
     axis = "model" if tp_size > 1 else None
-    serial = GPTModel(GPTConfig(axis=None, **TINY))
-    par = GPTModel(GPTConfig(axis=axis, **TINY))
+    cfg = dict(TINY, **cfg_overrides)
+    serial = GPTModel(GPTConfig(axis=None, **cfg))
+    par = GPTModel(GPTConfig(axis=axis, **cfg))
     params = serial.init(jax.random.PRNGKey(0))
     toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
     tgt = jnp.roll(toks, -1, axis=-1)
@@ -245,20 +246,18 @@ def test_pipeline_o2_with_mesh_grad_scaler():
 
 def test_deep_interleaved_pipeline_matches_serial():
     """The BASELINE config-5 shape at test scale: pp=4 with 2 virtual chunks
-    per stage (8 layer slabs), loss+grads must match serial."""
-    cfg = dict(TINY)
-    cfg["num_layers"] = 8
-    mesh = mesh_lib.make_virtual_mesh(4, pipeline_model_parallel_size=4)
+    per stage (8 layer slabs), loss AND all grads must match serial."""
+    mesh, serial, par, params, toks, tgt = _setup(pp=4, num_layers=8)
     try:
-        serial = GPTModel(GPTConfig(axis=None, **cfg))
-        par = GPTModel(GPTConfig(axis=None, **cfg))
-        params = serial.init(jax.random.PRNGKey(0))
-        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
-        tgt = jnp.roll(toks, -1, axis=-1)
         v_s, g_s = jax.value_and_grad(serial.loss)(params, toks, tgt)
         loss, rest_g, layer_g = _pipeline_value_and_grad(
             par, mesh, params, toks, tgt, M=4, vpp=2)
         np.testing.assert_allclose(float(v_s), loss, rtol=1e-5)
+        for name in ("embedding", "position", "ln_f"):
+            for x, y in zip(jax.tree.leaves(g_s[name]),
+                            jax.tree.leaves(rest_g[name])):
+                np.testing.assert_allclose(x, np.asarray(y), rtol=2e-4,
+                                           atol=2e-4, err_msg=name)
         for x, y in zip(jax.tree.leaves(g_s["layers"]), jax.tree.leaves(layer_g)):
             np.testing.assert_allclose(x, np.asarray(y), rtol=2e-4, atol=2e-4)
     finally:
