@@ -21,6 +21,17 @@ by XLA onto TPU:
                               (reference: csrc/, apex/contrib/csrc/)
 - ``apex_tpu.models``       — reference model zoo (ResNet, GPT, BERT, MLP)
                               (reference: examples/, apex/transformer/testing/)
+- ``apex_tpu.contrib``      — MHA modules, varlen FMHA, FastLayerNorm,
+                              RNN-T transducer, ASP 2:4 sparsity, groupbn
+                              (reference: apex/contrib/)
+- ``apex_tpu.fp16_utils``   — legacy manual mixed-precision API
+                              (reference: apex/fp16_utils/)
+- ``apex_tpu.checkpoint``   — one-pytree checkpoints, topology-independent
+                              resume (orbax or npz)
+- ``apex_tpu.pyprof``       — scopes/traces + XLA cost-model profiling
+                              (reference: apex/pyprof/)
+- ``apex_tpu.data``/``csrc``— host-side loaders; native C++ runtime pieces
+- ``apex_tpu.rnn``, ``apex_tpu.reparameterization`` — RNN zoo, weight norm
 """
 
 __version__ = "0.1.0"
