@@ -15,6 +15,10 @@ in ``apex_tpu.amp.MixedPrecisionOptimizer``.
 
 from apex_tpu.optimizers.fused_adam import fused_adam, FusedAdam  # noqa: F401
 from apex_tpu.optimizers.fused_lamb import fused_lamb, FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    FusedMixedPrecisionLamb,
+    FusedMixedPrecisionLambState,
+)
 from apex_tpu.optimizers.fused_sgd import fused_sgd, FusedSGD  # noqa: F401
 from apex_tpu.optimizers.fused_novograd import fused_novograd, FusedNovoGrad  # noqa: F401
 from apex_tpu.optimizers.fused_adagrad import fused_adagrad, FusedAdagrad  # noqa: F401

@@ -25,6 +25,44 @@ def multi_tree_map(fn, *trees, n_out: int):
     return tuple(treedef.unflatten([r[i] for r in results]) for i in range(n_out))
 
 
+def lamb_leaf_update(
+    g32,
+    p32,
+    m,
+    v,
+    *,
+    beta1,
+    beta2,
+    beta1_grad,
+    bc1,
+    bc2,
+    eps,
+    weight_decay,
+    use_nvlamb,
+    sumsq: Callable = None,
+):
+    """Shared per-leaf LAMB math (csrc/multi_tensor_lamb.cu stages 1+2):
+    Adam-style moments, bias correction, decoupled weight decay, per-tensor
+    trust ratio. Returns ``(trust_scaled_update, m_new, v_new)`` where the
+    parameter step is ``p32 - lr * trust_scaled_update``. ``sumsq`` lets
+    sharded callers psum squared partials across a mesh axis."""
+    if sumsq is None:
+        sumsq = lambda x: jnp.sum(jnp.square(x))  # noqa: E731
+    m_new = beta1 * m + beta1_grad * g32
+    v_new = beta2 * v + (1.0 - beta2) * jnp.square(g32)
+    upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if weight_decay != 0.0:
+        upd = upd + weight_decay * p32
+    w_norm = jnp.sqrt(sumsq(p32))
+    u_norm = jnp.sqrt(sumsq(upd))
+    ratio = jnp.where(
+        (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.asarray(1.0, jnp.float32)
+    )
+    if weight_decay == 0.0 and not use_nvlamb:
+        ratio = jnp.asarray(1.0, jnp.float32)
+    return ratio * upd, m_new, v_new
+
+
 def cast_like(updates, params):
     """Emit updates in each param's dtype (state math stays fp32)."""
     return jax.tree.map(lambda u, p: u.astype(p.dtype), updates, params)

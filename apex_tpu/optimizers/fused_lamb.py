@@ -20,6 +20,7 @@ from apex_tpu.ops.multi_tensor import tree_l2norm
 from apex_tpu.optimizers._common import (
     ClassOptimizer,
     cast_like,
+    lamb_leaf_update,
     multi_tree_map,
     tree_zeros_like,
 )
@@ -92,21 +93,22 @@ def fused_lamb(
 
         def _upd(g, p, m, v):
             g32 = g.astype(jnp.float32) / clip
-            p32 = p.astype(jnp.float32)
-            m_new = beta1 * m + beta1_grad * g32
-            v_new = beta2 * v + (1.0 - beta2) * jnp.square(g32)
-            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-            if weight_decay != 0.0:
-                upd = upd + weight_decay * p32
-            # Per-tensor trust ratio (multi_tensor_lamb.cu stage 2).
-            w_norm = jnp.sqrt(_sumsq(p32))
-            u_norm = jnp.sqrt(_sumsq(upd))
-            ratio = jnp.where(
-                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, jnp.asarray(1.0, jnp.float32)
+            scaled_upd, m_new, v_new = lamb_leaf_update(
+                g32,
+                p.astype(jnp.float32),
+                m,
+                v,
+                beta1=beta1,
+                beta2=beta2,
+                beta1_grad=beta1_grad,
+                bc1=bc1,
+                bc2=bc2,
+                eps=eps,
+                weight_decay=weight_decay,
+                use_nvlamb=use_nvlamb,
+                sumsq=_sumsq,
             )
-            if weight_decay == 0.0 and not use_nvlamb:
-                ratio = jnp.asarray(1.0, jnp.float32)
-            return (-step_lr * ratio * upd, m_new, v_new)
+            return (-step_lr * scaled_upd, m_new, v_new)
 
         updates, new_m, new_v = multi_tree_map(
             _upd, grads, params, state.exp_avg, state.exp_avg_sq, n_out=3

@@ -1,0 +1,191 @@
+"""FusedMixedPrecisionLamb — LAMB that owns its fp32 master weights.
+
+Reference: apex/optimizers/fused_mixed_precision_lamb.py (kernel
+csrc/multi_tensor_lamb_mp.cu). Unlike ``FusedLAMB`` (whose master-weight
+handling lives one level up in ``amp.MixedPrecisionOptimizer``), this variant
+carries the full-precision parameter copies *inside* the optimizer state and
+takes tensor-valued ``lr`` / ``scale`` / ``found_inf`` so a training step runs
+with zero host synchronization:
+
+- masters are cloned lazily at init from reduced-precision leaves
+  (``_setup_full_precision_params``, reference :117-127);
+- grads arrive *scaled*; the kernel unscales with ``inv_scale`` and the
+  global-norm clip compares against ``max_grad_norm * scale`` (reference
+  :181-189), which is mathematically the unscaled clip;
+- ``step`` increments only on non-overflow steps
+  (``group['step'] += (overflow != 1)``, reference :199-201) and the whole
+  update is skipped under ``lax.cond`` when ``found_inf`` is set;
+- the updated fp32 masters are written back out in the model dtype
+  (state list (4) "params reduced_dtype" of the _mp kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.ops.multi_tensor import tree_l2norm, tree_nonfinite
+from apex_tpu.optimizers._common import (
+    lamb_leaf_update,
+    multi_tree_map,
+    tree_zeros_like,
+)
+
+
+class FusedMixedPrecisionLambState(NamedTuple):
+    step: jax.Array
+    exp_avg: optax.Params
+    exp_avg_sq: optax.Params
+    #: fp32 full-precision copies of the model params (the reference's
+    #: ``param_groups_full_precision``); updated in place of the model params.
+    master: optax.Params
+
+
+class FusedMixedPrecisionLamb:
+    """Sync-free mixed-precision LAMB.
+
+    Usage::
+
+        opt = FusedMixedPrecisionLamb(lr=1e-3, reduced_precision_dtype=jnp.bfloat16)
+        state = opt.init(model_params)           # clones fp32 masters
+        new_params, state = opt.step(
+            state, model_params, scaled_grads, scale=loss_scale)
+
+    ``step`` returns model params in their original (reduced) dtype; the fp32
+    source of truth lives in ``state.master``.
+    """
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        step: int = 0,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        reduced_precision_dtype: Optional[Any] = None,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        if not adam_w_mode:
+            raise RuntimeError(
+                "FusedMixedPrecisionLamb only supports adam_w_mode (decoupled "
+                "wd), as the reference kernel does."
+            )
+        self.lr = lr
+        self._step0 = step
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.reduced_precision_dtype = reduced_precision_dtype
+
+    def _is_reduced(self, p) -> bool:
+        return (
+            self.reduced_precision_dtype is not None
+            and p.dtype == jnp.dtype(self.reduced_precision_dtype)
+        )
+
+    def init(self, model_params) -> FusedMixedPrecisionLambState:
+        # Masters exist only for reduced-precision leaves; fp32 leaves are
+        # updated directly (reference keeps None placeholders, :121-126 —
+        # here the "placeholder" is the fp32 leaf itself).
+        master = jax.tree.map(
+            lambda p: p.astype(jnp.float32) if self._is_reduced(p) else p,
+            model_params,
+        )
+        return FusedMixedPrecisionLambState(
+            step=jnp.asarray(self._step0, jnp.int32),
+            exp_avg=tree_zeros_like(model_params),
+            exp_avg_sq=tree_zeros_like(model_params),
+            master=master,
+        )
+
+    def step(
+        self,
+        state: FusedMixedPrecisionLambState,
+        model_params,
+        grads,
+        *,
+        lr_t=None,
+        scale=None,
+        found_inf=None,
+    ):
+        """One LAMB step. ``grads`` are grads of the ``scale``-scaled loss
+        (pass ``scale=None`` for unscaled grads). Returns
+        ``(new_model_params, new_state)``."""
+        beta1, beta2 = self.betas
+        step_lr = jnp.asarray(lr_t if lr_t is not None else self.lr, jnp.float32)
+        scale = jnp.asarray(1.0 if scale is None else scale, jnp.float32)
+        inv_scale = 1.0 / scale
+        if found_inf is None:
+            found_inf = tree_nonfinite(grads)
+        found_inf = jnp.asarray(found_inf, jnp.bool_)
+
+        # step advances only on clean steps (reference :199-201).
+        new_step = state.step + jnp.where(found_inf, 0, 1).astype(jnp.int32)
+        step_f = new_step.astype(jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - beta1 ** step_f
+            bc2 = 1.0 - beta2 ** step_f
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        beta1_grad = (1.0 - beta1) if self.grad_averaging else 1.0
+
+        # Global norm of the *scaled* grads vs max_grad_norm * scale
+        # (reference :181-189) == the unscaled-gradient clip factor.
+        grad_norm = tree_l2norm(grads)
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            clip = jnp.maximum(1.0, grad_norm / (self.max_grad_norm * scale))
+        else:
+            clip = jnp.asarray(1.0, jnp.float32)
+
+        def _upd(g, p32, m, v):
+            g32 = g.astype(jnp.float32) * inv_scale / clip
+            scaled_upd, m_new, v_new = lamb_leaf_update(
+                g32,
+                p32,
+                m,
+                v,
+                beta1=beta1,
+                beta2=beta2,
+                beta1_grad=beta1_grad,
+                bc1=bc1,
+                bc2=bc2,
+                eps=self.eps,
+                weight_decay=self.weight_decay,
+                use_nvlamb=self.use_nvlamb,
+            )
+            return (p32 - step_lr * scaled_upd, m_new, v_new)
+
+        def _do_step(operand):
+            master, m, v = operand
+            return multi_tree_map(_upd, grads, master, m, v, n_out=3)
+
+        def _skip_step(operand):
+            return operand
+
+        new_master, new_m, new_v = jax.lax.cond(
+            found_inf,
+            _skip_step,
+            _do_step,
+            (state.master, state.exp_avg, state.exp_avg_sq),
+        )
+        # fp32 master -> reduced model copy-out (state list (4) of the kernel).
+        new_model = jax.tree.map(
+            lambda mp, p: mp.astype(p.dtype), new_master, model_params
+        )
+        return new_model, FusedMixedPrecisionLambState(
+            step=new_step, exp_avg=new_m, exp_avg_sq=new_v, master=new_master
+        )

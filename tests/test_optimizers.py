@@ -185,3 +185,55 @@ def test_larc_clip_tracks_lr_t():
     # adaptive_lr clips to 1 in both; update scales with the applied lr
     np.testing.assert_allclose(u_small["w"], 0.5 * u_base["w"], rtol=1e-6)
     assert LARC(LARC(FusedSGD(lr=0.3))).lr == 0.3
+
+
+def test_fused_mixed_precision_lamb_matches_fused_lamb_with_masters():
+    """FusedMixedPrecisionLamb (masters inside the optimizer, scaled grads)
+    must match FusedLAMB run under amp.MixedPrecisionOptimizer's O2
+    master-weight path (reference: fused_mixed_precision_lamb.py vs
+    fused_lamb.py + _process_optimizer master handling)."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedLAMB, FusedMixedPrecisionLamb
+
+    lr, wd, scale = 1e-2, 0.01, 1024.0
+    base = _params()
+    model = jax.tree.map(lambda p: p.astype(jnp.bfloat16), base)
+
+    mp = FusedMixedPrecisionLamb(
+        lr=lr, weight_decay=wd, reduced_precision_dtype=jnp.bfloat16
+    )
+    st = mp.init(model)
+
+    ref_opt = amp.MixedPrecisionOptimizer(
+        FusedLAMB(lr=lr, weight_decay=wd),
+        amp.get_policy("O2", loss_scale=scale),
+    )
+    ref_st = ref_opt.init(model)
+
+    p_mp = p_ref = model
+    for i in range(4):
+        scaled = jax.tree.map(lambda g: (g * scale).astype(jnp.float32), _grads(i))
+        p_mp, st = mp.step(st, p_mp, scaled, scale=scale)
+        p_ref, ref_st, _ = ref_opt.apply_gradients(ref_st, p_ref, scaled)
+
+    assert int(st.step) == 4
+    for a, b in zip(jax.tree.leaves(st.master), jax.tree.leaves(ref_st.master)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_mp), jax.tree.leaves(p_ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_mixed_precision_lamb_skips_on_overflow():
+    from apex_tpu.optimizers import FusedMixedPrecisionLamb
+
+    mp = FusedMixedPrecisionLamb(lr=1e-2, reduced_precision_dtype=jnp.bfloat16)
+    model = jax.tree.map(lambda p: p.astype(jnp.bfloat16), _params())
+    st = mp.init(model)
+    bad = jax.tree.map(lambda g: g.at[0].set(jnp.inf) if g.ndim else g, _grads())
+    new_model, new_st = mp.step(st, model, bad, scale=2.0)
+    assert int(new_st.step) == 0  # step does not advance on overflow
+    for a, b in zip(jax.tree.leaves(new_model), jax.tree.leaves(model)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # moments untouched
+    for a, b in zip(jax.tree.leaves(new_st.exp_avg), jax.tree.leaves(st.exp_avg)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
