@@ -139,6 +139,18 @@ def main():
     if fused_batch != batch or base_batch != batch:
         # record the actually-measured config when OOM retries shrank it
         result["effective_batch"] = {"o2": fused_batch, "o0": base_batch}
+
+    # BASELINE.md target #3, measured directly: fused whole-tree optimizer
+    # step vs unfused per-leaf eager Adam (benchmarks/optimizer_step.py).
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        from optimizer_step import measure_speedup
+
+        speedup, _, _ = measure_speedup(fused_steps=5, eager_steps=2)
+        result["fused_opt_step_vs_eager"] = round(speedup, 2)
+    except Exception as e:  # noqa: BLE001 - never lose the headline metric
+        print(f"optimizer-step microbench failed: {e}", file=sys.stderr)
+
     print(json.dumps(result))
 
 
