@@ -22,20 +22,30 @@ axis:
   one program and overlaps compute with the permute collectives (the
   side-stream overlap of p2p_communication, for free).
 
-Embedding and LM head run replicated across ``pipe`` (their FLOPs would
-otherwise idle in the bubble), but their *loss contribution is masked to the
-owning stage* — so a spec-aware psum over ``pipe`` recovers exactly the
-reference's embedding-tie allreduce over the embedding group
-(parallel_state.py:165-184): it sums the input-embedding contribution
-(stage 0) with the tied LM-head contribution (stage S-1).
+The embedding gather runs replicated across ``pipe`` (negligible FLOPs) with
+its loss contribution attributed to stage 0; the LM head is **sharded over
+``pipe``**: finished activations are handed from the last stage to every
+stage (an all_gather whose AD transpose correctly sums the slice cotangents
+back to the source), each stage computes the vocab projection on its 1/S
+batch slice, and the spec-aware psum over ``pipe`` — the reference's
+embedding-tie allreduce over the embedding group (parallel_state.py:165-184)
+— combines both the tied-weight grads and the sharded head grads. Net
+effect: head FLOPs match the serial model instead of being paid S times.
 
 Interleaved virtual pipelining (reference
-fwd_bwd_pipelining_with_interleaving.py:25-333) runs as ``vpp`` sequential
-rings with Megatron's chunk placement — stage ``s`` chunk ``c`` holds the
-serial layer slab ``c*S + s`` (see :func:`interleave_stack`) — preserving the
-serial composition order and the per-stage memory layout of the interleaved
-schedule. (The bubble-overlap refinement of true interleaved 1F1B is a
-scheduling optimization on the same placement, left to a later round.)
+fwd_bwd_pipelining_with_interleaving.py:25-333) is a **single ring** with
+Megatron's chunk placement — stage ``s`` chunk ``c`` holds the serial layer
+slab ``c*S + s`` (see :func:`interleave_stack`). At tick ``t`` stage ``s``
+decodes its work unit ``k = t - s`` into (microbatch, chunk) as
+``j = k mod S``, ``q = (k div S) mod vpp``, ``m = (k div S*vpp)*S + j``: the
+timing algebra makes every ``ppermute`` deliver exactly the item the next
+stage must process, including the wrap from the last stage's chunk ``q``
+output to stage 0's chunk ``q+1`` input, with no idle tick in between. The
+schedule therefore takes ``vpp*M + S - 1`` ticks where sequential per-chunk
+rings take ``vpp*(M + S - 1)`` — the bubble shrinks by a factor of ``vpp``,
+the entire point of the reference's interleaved schedule. Like the
+reference, ``M`` must divide by ``S`` when ``vpp > 1``
+(fwd_bwd_pipelining_with_interleaving.py's divisibility assertion).
 """
 
 from __future__ import annotations
@@ -99,18 +109,55 @@ def _broadcast_from(x: jax.Array, axis: str, src: int) -> jax.Array:
     return lax.all_gather(x, axis, axis=0, tiled=False)[src]
 
 
+def pipeline_tick_count(
+    num_microbatches: int, pipeline_size: int, virtual_pipeline_size: int = 1
+) -> int:
+    """Scan length of the interleaved SPMD ring: ``vpp*M + S - 1`` — every
+    stage does its ``vpp*M`` real work units back-to-back after an ``s``-tick
+    fill, vs ``vpp*(M + S - 1)`` for sequential per-chunk rings. The saved
+    ``(vpp-1)*(S-1)`` ticks are the interleaving bubble win (reference:
+    fwd_bwd_pipelining_with_interleaving.py:25-333)."""
+    return virtual_pipeline_size * num_microbatches + pipeline_size - 1
+
+
 def _pipeline_ring(
     run_stage: Callable[[Any, jax.Array], jax.Array],
     layers_local: Any,
     h_microbatches: jax.Array,  # (M, mb, ...) — replicated across pipe
     axis: str,
+    vpp: int = 1,
 ) -> jax.Array:
-    """Rotate M microbatches through the stage ring once. Returns completed
-    activations (M, mb, ...), valid on the last stage (garbage elsewhere)."""
+    """Rotate M microbatches through the stage ring, through all ``vpp``
+    local chunks per stage (interleaved schedule). Returns completed
+    activations (M, mb, ...), valid on the last stage (garbage elsewhere).
+
+    Work-unit decode at tick ``t`` on stage ``s`` (k = t - s):
+    ``j = k mod S`` (microbatch within its group of S), ``q = (k div S) mod
+    vpp`` (local chunk), ``r = k div (S*vpp)`` (group), microbatch
+    ``m = r*S + j``. Stage s+1 processes unit k one tick after stage s
+    emitted it, and the last stage's chunk-q output arrives at stage 0
+    exactly when stage 0 is due to process (m, q+1) — one ppermute per tick
+    moves every in-flight item, with finished items exiting the ring on the
+    ticks when stage 0 injects fresh microbatches.
+    """
     S = lax.axis_size(axis)
     s_idx = lax.axis_index(axis)
     M = h_microbatches.shape[0]
-    n_ticks = M + S - 1
+    if vpp > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) divisible by "
+            f"pipeline size ({S}), as in the reference"
+        )
+    n_units = vpp * M
+    n_ticks = pipeline_tick_count(M, S, vpp)
+
+    n_local = jax.tree.leaves(layers_local)[0].shape[0]
+    if n_local % vpp:
+        raise ValueError(
+            f"per-stage layer count ({n_local}) must divide by "
+            f"virtual_pipeline_size ({vpp})"
+        )
+    per = n_local // vpp
 
     mb_shape = h_microbatches.shape[1:]
     out0 = jnp.zeros((M,) + mb_shape, h_microbatches.dtype)
@@ -119,15 +166,28 @@ def _pipeline_ring(
 
     def tick(carry, t):
         buf, out = carry
-        inject = jnp.minimum(t, M - 1)
-        h_in = jnp.where(s_idx == 0, h_microbatches[inject], buf)
-        h_out = run_stage(layers_local, h_in)
-        done = t - (S - 1)
-        idx = jnp.clip(done, 0, M - 1)
-        valid = (s_idx == S - 1) & (done >= 0)
-        cur = lax.dynamic_index_in_dim(out, idx, 0, keepdims=False)
+        k_raw = t - s_idx
+        k = jnp.clip(k_raw, 0, n_units - 1)
+        j = k % S
+        q = (k // S) % vpp
+        m = (k // (S * vpp)) * S + j
+        inject = (s_idx == 0) & (q == 0)
+        h_in = jnp.where(
+            inject, lax.dynamic_index_in_dim(h_microbatches, m, 0, keepdims=False), buf
+        )
+        if vpp == 1:
+            chunk = layers_local
+        else:
+            chunk = jax.tree.map(
+                lambda x: lax.dynamic_slice_in_dim(x, q * per, per, axis=0),
+                layers_local,
+            )
+        h_out = run_stage(chunk, h_in)
+        live = (k_raw >= 0) & (k_raw < n_units)
+        finished = (s_idx == S - 1) & (q == vpp - 1) & live
+        cur = lax.dynamic_index_in_dim(out, m, 0, keepdims=False)
         out = lax.dynamic_update_index_in_dim(
-            out, jnp.where(valid, h_out, cur), idx, 0
+            out, jnp.where(finished, h_out, cur), m, 0
         )
         buf = lax.ppermute(h_out, axis, perm)
         return (buf, out), None
@@ -144,6 +204,7 @@ def pipelined_loss_fn(
     num_microbatches: int,
     axis: str = AXIS_PIPE,
     virtual_pipeline_size: int = 1,
+    shard_head: bool = True,
 ) -> Callable:
     """Build ``loss(params, layers_local, batch, targets) -> scalar`` running
     the layer stack through the SPMD pipeline.
@@ -151,12 +212,15 @@ def pipelined_loss_fn(
     Args:
       embed: ``(params, batch) -> (B, ...) activations`` (replicated work).
       run_layers: ``(layer_chunk_params, h) -> h`` applying a stage chunk.
-      head_loss: ``(params, h, targets) -> per-element loss`` (replicated
-        work, masked to the last stage).
+      head_loss: ``(params, h, targets) -> per-element loss``.
       num_microbatches: M; the batch dim must divide by it.
       axis: pipeline mesh axis (bound inside shard_map).
       virtual_pipeline_size: interleaved chunks per stage; layer stacks must
         be pre-permuted with :func:`interleave_stack` when > 1.
+      shard_head: compute the (vocab-sized, expensive) head on a 1/S batch
+        slice per stage instead of replicating it — total head FLOPs then
+        match the serial model. Falls back to the replicated+masked head
+        when the batch does not divide by S.
 
     Run inside ``shard_map`` with layer params sharded by
     :func:`pipeline_specs`; ``params`` holds the non-pipelined parameters
@@ -167,32 +231,46 @@ def pipelined_loss_fn(
 
     def loss_fn(params, layers_local, batch, targets):
         S = lax.axis_size(axis)
+        s_idx = lax.axis_index(axis)
         h = embed(params, batch)
         bsz = h.shape[0]
         if bsz % M:
             raise ValueError(f"batch ({bsz}) must divide by microbatches ({M})")
         h_mb = h.reshape((M, bsz // M) + h.shape[1:])
 
-        n_local = jax.tree.leaves(layers_local)[0].shape[0]
-        per = n_local // vpp
-        for c in range(vpp):
-            chunk = jax.tree.map(lambda x: x[c * per:(c + 1) * per], layers_local)
-            out = _pipeline_ring(run_layers, chunk, h_mb, axis)
-            if c < vpp - 1:
-                # ring c's outputs (on the last stage) are ring c+1's inputs
-                # (injected by stage 0): hand them around the ring.
-                h_mb = _broadcast_from(out, axis, S - 1)
-
+        out = _pipeline_ring(run_layers, layers_local, h_mb, axis, vpp=vpp)
         h_full = out.reshape((bsz,) + out.shape[2:])
-        per_loss = head_loss(params, h_full, targets)
-        # Only the last stage holds real outputs; mask then psum (identity
-        # backward, Megatron cotangent convention) so head/embedding grads
-        # attribute to their owning stage.
-        local = jnp.where(
-            lax.axis_index(axis) == S - 1,
-            jnp.mean(per_loss),
-            jnp.zeros((), per_loss.dtype),
-        )
+
+        if shard_head and S > 1 and bsz % S == 0:
+            # Scatter the last stage's finished activations: mask non-last
+            # stages to zero, then reduce-scatter so stage s receives batch
+            # rows [s*share, (s+1)*share) — 1/S the comm volume of an
+            # all_gather, and psum_scatter's AD transpose (an all_gather)
+            # sums the per-stage slice cotangents back onto the last stage.
+            # Each stage then projects only its slice through the vocab
+            # head, so head FLOPs total the serial model's.
+            share = bsz // S
+            h_masked = jnp.where(s_idx == S - 1, h_full, jnp.zeros_like(h_full))
+            h_loc = lax.psum_scatter(h_masked, axis, scatter_dimension=0, tiled=True)
+            t_loc = jax.tree.map(
+                lambda t: lax.dynamic_slice_in_dim(t, s_idx * share, share, axis=0),
+                targets,
+            )
+            per_loss = head_loss(params, h_loc, t_loc)
+            # each stage contributes mean(slice)/S; the identity-backward
+            # psum makes the sum the full-batch mean while routing each
+            # stage's head grads through its own slice only.
+            local = jnp.mean(per_loss) / S
+        else:
+            per_loss = head_loss(params, h_full, targets)
+            # Only the last stage holds real outputs; mask then psum
+            # (identity backward, Megatron cotangent convention) so
+            # head/embedding grads attribute to their owning stage.
+            local = jnp.where(
+                s_idx == S - 1,
+                jnp.mean(per_loss),
+                jnp.zeros((), per_loss.dtype),
+            )
         return _psum_identity_bwd(local, axis)
 
     return loss_fn

@@ -262,3 +262,116 @@ def test_deep_interleaved_pipeline_matches_serial():
             np.testing.assert_allclose(x, np.asarray(y), rtol=2e-4, atol=2e-4)
     finally:
         mesh_lib.destroy_model_parallel()
+
+
+def _scan_lengths(jaxpr):
+    """All lax.scan trip counts in a (closed) jaxpr, recursively."""
+
+    lengths = []
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            lengths.append(eqn.params["length"])
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for item in vs:
+                if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                    lengths.extend(_scan_lengths(item))
+    return lengths
+
+
+def test_interleaved_tick_count_shrinks_bubble():
+    """The interleaved schedule must run in vpp*M + S - 1 ticks, strictly
+    fewer than the vpp*(M + S - 1) of sequential per-chunk rings (the
+    reference's whole reason for fwd_bwd_pipelining_with_interleaving)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pipeline_tick_count,
+    )
+
+    S, M, vpp = 4, 4, 2
+    assert pipeline_tick_count(M, S, vpp) == vpp * M + S - 1 == 11
+    assert pipeline_tick_count(M, S, vpp) < vpp * (M + S - 1) == 14
+
+    # and the traced program really scans that many ticks
+    mesh, serial, par, params, toks, tgt = _setup(pp=S, num_layers=8)
+    try:
+        specs = par.specs()
+        layer_specs = pipeline_specs(specs["layers"])
+        rest_specs = {k: v for k, v in specs.items() if k != "layers"}
+        layers = interleave_stack(params["layers"], S, vpp)
+        rest = {k: v for k, v in params.items() if k != "layers"}
+
+        loss_fn = pipelined_loss_fn(
+            embed=par.embed,
+            run_layers=lambda lp, h: par.run_layers(lp, h),
+            head_loss=lambda p, h, t: par.head(p, h, t),
+            num_microbatches=M,
+            virtual_pipeline_size=vpp,
+        )
+        fn = jax.shard_map(
+            loss_fn, mesh=mesh,
+            in_specs=(rest_specs, layer_specs, P(), P()),
+            out_specs=P(), check_vma=False,
+        )
+        jaxpr = jax.make_jaxpr(fn)(rest, layers, toks, tgt)
+        lengths = _scan_lengths(jaxpr)
+        assert lengths, "no scan found in pipelined loss"
+        assert max(lengths) == pipeline_tick_count(M, S, vpp)
+        assert vpp * (M + S - 1) not in lengths
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_sharded_head_flops_match_serial():
+    """With the pipe-sharded LM head, total pipelined FLOPs at pp=4 must be
+    within ~1.15x of the serial step (VERDICT round-1 criterion); with the
+    replicated head they are several x (head paid S times)."""
+    S, M = 4, 16
+    cfg = dict(TINY, vocab_size=2048, num_layers=4)
+    mesh = mesh_lib.make_virtual_mesh(S, pipeline_model_parallel_size=S)
+    try:
+        serial = GPTModel(GPTConfig(axis=None, **cfg))
+        par = GPTModel(GPTConfig(axis=None, **cfg))
+        params = serial.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (32, 16), 0, 2048)
+        tgt = jnp.roll(toks, -1, axis=-1)
+
+        serial_flops = (
+            jax.jit(jax.value_and_grad(serial.loss))
+            .lower(params, toks, tgt).compile().cost_analysis()["flops"]
+        )
+
+        specs = par.specs()
+        layer_specs = pipeline_specs(specs["layers"])
+        rest_specs = {k: v for k, v in specs.items() if k != "layers"}
+        rest = {k: v for k, v in params.items() if k != "layers"}
+
+        def per_device_flops(shard_head):
+            loss_fn = pipelined_loss_fn(
+                embed=par.embed,
+                run_layers=lambda lp, h: par.run_layers(lp, h),
+                head_loss=lambda p, h, t: par.head(p, h, t),
+                num_microbatches=M,
+                shard_head=shard_head,
+            )
+            fn = jax.jit(jax.shard_map(
+                lambda r, l, b, t: jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                    r, l, b, t),
+                mesh=mesh,
+                in_specs=(rest_specs, layer_specs, P(), P()),
+                out_specs=(P(), (rest_specs, layer_specs)),
+                check_vma=False,
+            ))
+            return fn.lower(rest, params["layers"], toks, tgt).compile(
+            ).cost_analysis()["flops"]
+
+        # cost_analysis reports the per-device SPMD program; x S for totals
+        sharded_total = per_device_flops(True) * S
+        replicated_total = per_device_flops(False) * S
+        assert sharded_total <= 1.15 * serial_flops, (
+            f"sharded-head pipeline {sharded_total/serial_flops:.2f}x serial")
+        assert replicated_total >= 2.0 * serial_flops, (
+            "replicated head should cost ~S x the serial head; got "
+            f"{replicated_total/serial_flops:.2f}x — test no longer discriminates")
+    finally:
+        mesh_lib.destroy_model_parallel()
