@@ -12,9 +12,13 @@ weights, and patches ``optimizer.step`` to re-apply masks after each update
     ... inside train step, after the optimizer update:
     params = apply_masks(params, masks)             # the patched-step re-mask
 
-The channel-permutation search (permutation_lib.py, 925 LoC + CUDA) that
-recovers accuracy for permuted channels is out of scope; masks here are the
-``m4n2_1d`` default pattern (sparse_masklib.py create_mask).
+The channel-permutation search that recovers accuracy (reference
+permutation_lib.py + CUDA search kernels) lives in
+:mod:`apex_tpu.contrib.sparsity.permutation` — run
+:func:`search_and_permute` before :func:`compute_sparse_masks` to find
+function-preserving channel orders that keep more magnitude under the
+mask. Masks here are the ``m4n2_1d`` default pattern
+(sparse_masklib.py create_mask).
 
 On-TPU value: 2:4 is an NVIDIA Ampere hardware feature; TPUs have no sparse
 MXU mode, so the win here is algorithmic parity (sparse fine-tuning
@@ -27,6 +31,16 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.permutation import (  # noqa: F401
+    ChannelGroup,
+    apply_channel_permutation,
+    magnitude_after_mask,
+    search_and_permute,
+    search_for_good_permutation,
+    sequential_groups,
+    sum_after_2_to_4,
+)
 
 
 def m4n2_mask_1d(w: jax.Array, axis: int = -2) -> jax.Array:

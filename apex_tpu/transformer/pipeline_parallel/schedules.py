@@ -24,10 +24,11 @@ axis:
 
 The embedding gather runs replicated across ``pipe`` (negligible FLOPs) with
 its loss contribution attributed to stage 0; the LM head is **sharded over
-``pipe``**: finished activations are handed from the last stage to every
-stage (an all_gather whose AD transpose correctly sums the slice cotangents
-back to the source), each stage computes the vocab projection on its 1/S
-batch slice, and the spec-aware psum over ``pipe`` — the reference's
+``pipe``**: the last stage's finished activations are ``psum_scatter``-ed so
+each stage receives a 1/S batch slice (1/S the comm volume of an all_gather;
+the AD transpose — an all_gather — sums the slice cotangents back onto the
+last stage), each stage computes the vocab projection on its slice, and the
+spec-aware psum over ``pipe`` — the reference's
 embedding-tie allreduce over the embedding group (parallel_state.py:165-184)
 — combines both the tied-weight grads and the sharded head grads. Net
 effect: head FLOPs match the serial model instead of being paid S times.
@@ -101,12 +102,6 @@ def deinterleave_stack(layers: Any, pipeline_size: int, virtual_pipeline_size: i
     )
     inv = np.argsort(order)
     return jax.tree.map(lambda x: x[inv], layers)
-
-
-def _broadcast_from(x: jax.Array, axis: str, src: int) -> jax.Array:
-    """Broadcast src's shard (AD: cotangent returns only to src — consistent
-    with stage-masked losses)."""
-    return lax.all_gather(x, axis, axis=0, tiled=False)[src]
 
 
 def pipeline_tick_count(
