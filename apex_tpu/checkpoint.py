@@ -11,10 +11,13 @@ Design points (TPU-native):
 
 - **orbax** backend when available (async-capable, multi-host aware), with a
   dependency-free ``.npz`` fallback so the module works anywhere;
-- **topology-independent**: arrays are saved as host numpy in the tree's
-  logical (unsharded) shapes; on restore the caller re-applies whatever
-  ``NamedSharding`` the *new* mesh prescribes (``restore(..., sharding_tree=)``)
-  — resume may change mesh shape (SURVEY.md §5 failure-detection note);
+- **topology-independent**: the tree is saved in its logical (unsharded)
+  shapes — orbax writes sharded ``jax.Array`` leaves shard-by-shard without
+  a host gather (multi-host safe); npz host-gathers. On restore the caller
+  passes whatever ``NamedSharding`` the *new* mesh prescribes
+  (``restore(..., sharding_tree=)``) and leaves materialize directly into
+  it, or omits it to get host numpy on any topology — resume may change
+  mesh shape (SURVEY.md §5 failure-detection note);
 - step-numbered directories with ``latest_step`` discovery, the
   ``save_checkpoint``/``load_checkpoint`` UX of Megatron-style trainers.
 """
@@ -113,19 +116,24 @@ def latest_step(directory: str) -> Optional[int]:
 
 def save_checkpoint(directory: str, step: int, state: Any, *, backend: str = "auto") -> str:
     """Save ``state`` (any pytree: params, MPOptState, FP16OptState, …) under
-    ``directory/step_{step}``. Returns the checkpoint path."""
+    ``directory/step_{step}``. Returns the checkpoint path.
+
+    With the orbax backend, sharded ``jax.Array`` leaves are saved **without
+    a host gather** — every host/process writes only its own shards (orbax's
+    multi-host OCDBT protocol), so the same call scales from one chip to a
+    multi-host pod. The npz fallback is a host-gathered single file and is
+    only suitable single-host."""
     use_orbax = _ocp is not None if backend == "auto" else backend == "orbax"
     if use_orbax and _ocp is None:
         raise RuntimeError("backend='orbax' requested but orbax is unavailable")
     path = _step_dir(directory, step)
     os.makedirs(directory, exist_ok=True)
-    host_state = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), state)
     if use_orbax:
         ckptr = _ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.abspath(path), host_state, force=True)
+        ckptr.save(os.path.abspath(path), state, force=True)
     else:
         os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, "state.npz"), **_flatten(host_state))
+        np.savez(os.path.join(path, "state.npz"), **_flatten(state))
     return path
 
 
@@ -141,9 +149,17 @@ def restore_checkpoint(
     structure of ``target``.
 
     ``sharding_tree``: optional pytree of ``jax.sharding.Sharding`` (same
-    structure, e.g. built from ``model.specs()`` and the *current* mesh) —
-    each restored leaf is ``device_put`` to its sharding, which is what makes
-    resume topology-independent."""
+    structure, e.g. built from ``model.specs()`` and the *current* mesh).
+    The current mesh need not match the one the checkpoint was saved on —
+    resume may reshape (e.g. pp=2×tp=2 → tp=4); this is what makes resume
+    topology-independent (SURVEY.md §5).
+
+    With the orbax backend, shardings are honored **at read time**: each
+    leaf materializes directly into its target ``NamedSharding``, every
+    host/process reading only the byte ranges its shards need — no
+    host-gathered full copy exists at any point, so restore scales to
+    states larger than one host's memory. The npz path restores to host
+    then ``device_put``s each leaf."""
     if step is None:
         step = latest_step(directory)
         if step is None:
@@ -153,17 +169,31 @@ def restore_checkpoint(
     if backend == "npz" or (backend == "auto" and os.path.exists(npz)):
         with np.load(npz) as z:
             restored = _unflatten_into(target, dict(z))
-    else:
-        if _ocp is None:
-            raise RuntimeError("orbax unavailable and no npz checkpoint found")
-        ckptr = _ocp.PyTreeCheckpointer()
-        host_target = jax.tree.map(
-            lambda a: _ocp.utils.to_shape_dtype_struct(a)
-            if hasattr(_ocp.utils, "to_shape_dtype_struct") else a,
-            target,
-        )
-        restored = ckptr.restore(os.path.abspath(path), item=host_target)
-    # re-cast non-float metadata exactly; reapply shardings if given
+        if sharding_tree is not None:
+            restored = jax.tree.map(jax.device_put, restored, sharding_tree)
+        return restored
+    if _ocp is None:
+        raise RuntimeError("orbax unavailable and no npz checkpoint found")
+    ckptr = _ocp.PyTreeCheckpointer()
     if sharding_tree is not None:
-        restored = jax.tree.map(jax.device_put, restored, sharding_tree)
-    return restored
+        sds_target = jax.tree.map(
+            lambda t, s: jax.ShapeDtypeStruct(
+                np.shape(t), np.asarray(t).dtype if not hasattr(t, "dtype") else t.dtype,
+                sharding=s,
+            ),
+            target,
+            sharding_tree,
+        )
+        restore_args = _ocp.checkpoint_utils.construct_restore_args(sds_target)
+        return ckptr.restore(
+            os.path.abspath(path), item=sds_target, restore_args=restore_args
+        )
+    # No sharding_tree: restore every leaf as host numpy so the checkpoint
+    # opens on any topology (inspection hosts, smaller pods) regardless of
+    # the shardings it was saved with.
+    restore_args = jax.tree.map(
+        lambda _: _ocp.RestoreArgs(restore_type=np.ndarray), target
+    )
+    return ckptr.restore(
+        os.path.abspath(path), item=target, restore_args=restore_args
+    )

@@ -330,6 +330,11 @@ def search_for_good_permutation(
     k, c = matrix.shape
     if c % GROUP_WIDTH:
         raise ValueError(f"channel count {c} not divisible by {GROUP_WIDTH}")
+    if stripe_group_size % GROUP_WIDTH:
+        raise ValueError(
+            f"stripe_group_size ({stripe_group_size}) must be a multiple of "
+            f"{GROUP_WIDTH}"
+        )
     rng = np.random.default_rng(seed)
 
     total = float(np.abs(matrix).sum(dtype=np.float64))

@@ -105,6 +105,103 @@ def test_topology_independent_resume(tmp_path):
         mesh_lib.destroy_model_parallel()
 
 
+@pytest.mark.skipif(checkpoint._ocp is None, reason="orbax unavailable")
+def test_sharded_mpoptstate_mesh_reshape_resume(tmp_path):
+    """The multi-host-safe resume contract (SURVEY.md §5): a full MPOptState
+    laid out sharded on a pp=2 x tp=2 mesh is orbax-saved *without a host
+    gather* and restored directly into the shardings of a different mesh
+    (tp=4) — values, scaler state, and a loss computation all survive the
+    reshape."""
+    from apex_tpu.amp.frontend import MPOptState
+    from apex_tpu.optimizers.fused_adam import FusedAdamState
+    from apex_tpu.transformer.pipeline_parallel.schedules import pipeline_specs
+
+    model, mp_opt, params, opt_state = _train_state()
+    par = GPTModel(GPTConfig(axis="model", **TINY))
+
+    def shardings_for(mesh, pipeline_sharded):
+        pspecs = dict(par.specs())
+        if pipeline_sharded:
+            pspecs["layers"] = pipeline_specs(pspecs["layers"])
+        param_sh = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        repl = NamedSharding(mesh, P())
+        return {
+            "step": repl,
+            "params": param_sh,
+            "opt": MPOptState(
+                inner=FusedAdamState(repl, param_sh, param_sh),
+                master=param_sh,
+                scaler=jax.tree.map(lambda _: repl, opt_state.scaler),
+            ),
+        }
+
+    state = {"step": jnp.asarray(3), "params": params, "opt": opt_state}
+
+    mesh_a = mesh_lib.make_virtual_mesh(
+        8, tensor_model_parallel_size=2, pipeline_model_parallel_size=2)
+    try:
+        sharded = jax.tree.map(jax.device_put, state, shardings_for(mesh_a, True))
+        # genuinely sharded across pipe x model before saving
+        assert len(sharded["params"]["layers"]["qkv"]["kernel"].sharding
+                   .device_set) >= 4
+        checkpoint.save_checkpoint(str(tmp_path), 3, sharded, backend="orbax")
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+    mesh_b = mesh_lib.make_virtual_mesh(4, tensor_model_parallel_size=4)
+    try:
+        target = jax.tree.map(jnp.zeros_like, state)
+        sh_b = shardings_for(mesh_b, False)
+        restored = checkpoint.restore_checkpoint(
+            str(tmp_path), target, 3, sharding_tree=sh_b, backend="orbax")
+        kern = restored["params"]["layers"]["qkv"]["kernel"]
+        assert kern.sharding == sh_b["params"]["layers"]["qkv"]["kernel"]
+        assert kern.dtype == jnp.bfloat16
+        assert int(restored["step"]) == 3
+        assert float(restored["opt"].scaler.loss_scale) == float(
+            opt_state.scaler.loss_scale)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            restored["opt"].master, jax.device_get(opt_state.master))
+        # the restored sharded params compute the same loss as the originals
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        tgt = jnp.roll(toks, -1, axis=-1)
+        specs = par.specs()
+        loss = jax.jit(jax.shard_map(
+            lambda p, t, g: par.loss(
+                jax.tree.map(lambda x: x.astype(jnp.float32), p), t, g),
+            mesh=mesh_b, in_specs=(specs, P(), P()),
+            out_specs=P(), check_vma=False))(restored["params"], toks, tgt)
+        ref = model.loss(
+            jax.tree.map(lambda x: x.astype(jnp.float32), jax.device_get(params)),
+            toks, tgt)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=2e-5)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+@pytest.mark.skipif(checkpoint._ocp is None, reason="orbax unavailable")
+def test_sharded_save_restores_as_host_numpy_without_shardings(tmp_path):
+    """A checkpoint saved from sharded arrays must still open with no
+    sharding_tree (inspection host / different device set): leaves come
+    back as host numpy, ignoring the recorded shardings."""
+    mesh = mesh_lib.make_virtual_mesh(8, tensor_model_parallel_size=8)
+    try:
+        x = jax.device_put(
+            jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            NamedSharding(mesh, P("model", None)))
+        checkpoint.save_checkpoint(str(tmp_path), 0, {"x": x}, backend="orbax")
+    finally:
+        mesh_lib.destroy_model_parallel()
+    r = checkpoint.restore_checkpoint(
+        str(tmp_path), {"x": jnp.zeros((8, 8))}, 0, backend="orbax")
+    np.testing.assert_array_equal(
+        np.asarray(r["x"]), np.arange(64, dtype=np.float32).reshape(8, 8))
+
+
 def test_missing_leaf_errors(tmp_path):
     checkpoint.save_checkpoint(str(tmp_path), 0, {"a": jnp.ones(2)}, backend="npz")
     with pytest.raises(KeyError):
