@@ -2,7 +2,9 @@
 
 Implemented here: multihead_attn (fused self/enc-dec MHA ± norm-add),
 fmha (packed cu_seqlens varlen attention over the flash kernel),
-layer_norm (FastLayerNorm), sparsity (ASP 2:4), transducer (RNN-T).
+layer_norm (FastLayerNorm), sparsity (ASP 2:4 + channel-permutation
+search), transducer (RNN-T), bottleneck (fused frozen-BN ResNet block
+with a compile-time fusion guarantee).
 Elsewhere: xentropy lives in apex_tpu.ops.xentropy; groupbn's NHWC BN maps
 to apex_tpu.parallel.SyncBatchNorm(channel_last=True); the distributed
 (ZeRO) optimizers live in apex_tpu.optimizers.distributed.

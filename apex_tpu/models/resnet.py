@@ -157,3 +157,17 @@ ResNet34 = partial(_resnet, (3, 4, 6, 3), BasicBlock)
 ResNet50 = partial(_resnet, (3, 4, 6, 3), Bottleneck)
 ResNet101 = partial(_resnet, (3, 4, 23, 3), Bottleneck)
 ResNet152 = partial(_resnet, (3, 8, 36, 3), Bottleneck)
+
+
+def _frozen_resnet(stage_sizes, **kw) -> ResNet:
+    """ResNet built from :class:`apex_tpu.contrib.bottleneck.FastBottleneck`
+    — frozen-BN blocks with the fused conv+scale/bias+ReLU+residual chain,
+    the detection-backbone configuration of the reference's fast_bottleneck
+    extension (apex/contrib/bottleneck/bottleneck.py)."""
+    from apex_tpu.contrib.bottleneck import FastBottleneck
+
+    return ResNet(stage_sizes=stage_sizes, block_cls=FastBottleneck, **kw)
+
+
+ResNet50Frozen = partial(_frozen_resnet, (3, 4, 6, 3))
+ResNet101Frozen = partial(_frozen_resnet, (3, 4, 23, 3))
