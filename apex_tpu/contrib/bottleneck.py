@@ -15,11 +15,14 @@ drelu/dscale backward) is eager-CUDA work that XLA performs in the
 compiler — every scale/bias/ReLU/add here is an elementwise epilogue that
 XLA fuses into its producing convolution, and backward comes from AD with
 the same fusion. What this module contributes is the **frozen-BN surface**
-(fold helper + per-channel scale/bias params instead of live batch stats)
-and a **compile-time fusion guarantee**: :func:`assert_epilogues_fused`
-inspects the compiled HLO and fails if any elementwise epilogue escaped
-into its own top-level instruction, which is the contract the reference
-buys with hand-written kernels. ``tests/test_bottleneck.py`` pins it.
+(:func:`fold_batchnorm` + :class:`FrozenBatchNorm`, a drop-in for the
+framework's norm factories) and a **compile-time fusion guarantee**:
+:func:`assert_epilogues_fused` inspects the compiled HLO and fails if any
+elementwise epilogue escaped into its own top-level instruction, which is
+the contract the reference buys with hand-written kernels.
+:class:`FastBottleneck` is the block itself — structurally the one
+bottleneck implementation in :mod:`apex_tpu.models.resnet` with the norm
+frozen, so the two can never drift.
 
 The spatial-parallelism variant (``SpatialBottleneck``, splitting the H
 dim across GPUs with halo exchanges) is covered by this framework's
@@ -29,12 +32,14 @@ general sharding story: shard NHWC activations over a mesh axis with
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Tuple
+import re
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+from apex_tpu.models.resnet import Bottleneck, ModuleDef
 
 __all__ = ["FrozenBatchNorm", "FastBottleneck", "fold_batchnorm",
            "assert_epilogues_fused"]
@@ -57,80 +62,95 @@ class FrozenBatchNorm(nn.Module):
     (FrozenBatchNorm2d, bottleneck.py:10-35): a per-channel scale/bias
     whose parameters can be initialized from :func:`fold_batchnorm`.
 
-    Parameter names carry the ``bn`` marker via the module name so amp's
-    ``keep_batchnorm_fp32`` treats them like live BN params."""
+    Accepts (and ignores) the :class:`~apex_tpu.parallel.SyncBatchNorm`
+    constructor/call surface so it slots into any ``norm``/``norm_cls``
+    factory in this codebase — frozen stats have no momentum, no cross-rank
+    sync, and no train/eval distinction. Module names carry the ``bn``
+    marker so amp's ``keep_batchnorm_fp32`` treats the params like live BN
+    params."""
 
-    features: int
     fuse_relu: bool = False
-    dtype: Any = jnp.float32
+    # accepted-and-ignored SyncBatchNorm surface (factory compatibility)
+    momentum: float = 0.1
+    axis_name: Optional[str] = None
+    group_size: Optional[int] = None
+    channel_last: bool = True
 
     @nn.compact
-    def __call__(self, x):
-        s = self.param("scale", nn.initializers.ones, (self.features,), jnp.float32)
-        b = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+    def __call__(self, x, use_running_average: bool = True):
+        c = x.shape[-1]
+        s = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
         y = x * s.astype(x.dtype) + b.astype(x.dtype)
         return jax.nn.relu(y) if self.fuse_relu else y
 
 
-class FastBottleneck(nn.Module):
+class FastBottleneck(Bottleneck):
     """NHWC 1x1 → 3x3 → 1x1 bottleneck with frozen-BN scale/bias epilogues
     and fused residual add+ReLU (Bottleneck, bottleneck.py:224-320).
 
-    Drop-in for :class:`apex_tpu.models.resnet.Bottleneck` as a ResNet
-    ``block_cls`` (the ``norm`` attr is accepted for signature parity and
-    unused — frozen scale/bias replaces live BN). v1.5 stride placement:
-    stride on the 3x3, like the reference's ``stride_1x1=False`` default.
-    """
+    This *is* :class:`apex_tpu.models.resnet.Bottleneck` with the norm
+    pinned to :class:`FrozenBatchNorm` — same v1.5 stride placement
+    (stride on the 3x3, the reference's ``stride_1x1=False`` default),
+    same downsample trigger, same parameter naming; only the per-channel
+    epilogue differs, which is exactly the reference module's delta from a
+    live-BN bottleneck. The ``norm`` attr (which ResNet's block wiring
+    always supplies) is accepted and **ignored**: this block freezes
+    unconditionally — frozen-by-construction is its contract."""
 
-    filters: int
-    strides: int = 1
-    norm: Any = None  # signature parity with Bottleneck; frozen BN instead
-    dtype: Any = jnp.float32
-    expansion: int = 4
+    norm: ModuleDef = FrozenBatchNorm  # documented: ignored, always frozen
 
     @nn.compact
     def __call__(self, x, use_running_average: bool = True):
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        fbn = partial(FrozenBatchNorm, dtype=self.dtype)
-        out = self.filters * self.expansion
-        residual = x
-        y = conv(self.filters, (1, 1), name="conv1")(x)
-        y = fbn(self.filters, fuse_relu=True, name="bn1")(y)
-        y = conv(self.filters, (3, 3), strides=self.strides, padding=1,
-                 name="conv2")(y)
-        y = fbn(self.filters, fuse_relu=True, name="bn2")(y)
-        y = conv(out, (1, 1), name="conv3")(y)
-        y = fbn(out, name="bn3")(y)
-        if residual.shape != y.shape:
-            residual = conv(out, (1, 1), strides=self.strides, name="conv_ds")(x)
-            residual = fbn(out, name="bn_ds")(residual)
-        return jax.nn.relu(y + residual)
+        return self._forward(x, FrozenBatchNorm, use_running_average)
 
 
-# ops that may appear at HLO top level without indicating a missed fusion:
-# data movement, control, convs/GEMMs themselves, and fusions.
+# HLO ops that may legitimately appear at top level: structure, data
+# movement, the compute primitives themselves (convs/dots/reductions),
+# control flow, collectives, and fusions. Anything else — add, multiply,
+# maximum, select, compare, tanh, … — is an elementwise epilogue that
+# should have been fused, and is flagged.
 _NON_EPILOGUE_OPS = frozenset({
     "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
-    "bitcast-convert", "copy", "convert", "transpose", "reshape",
-    "convolution", "dot", "custom-call", "fusion", "call", "reduce",
-    "broadcast", "slice", "pad", "iota", "compare", "select",
+    "bitcast-convert", "copy", "copy-start", "copy-done", "convert",
+    "transpose", "reshape", "convolution", "dot", "custom-call", "fusion",
+    "call", "reduce", "reduce-window", "broadcast", "slice",
+    "dynamic-slice", "dynamic-update-slice", "pad", "iota", "concatenate",
+    "gather", "scatter", "sort", "while", "conditional", "rng",
+    "rng-bit-generator", "all-reduce", "all-gather", "reduce-scatter",
+    "all-to-all", "collective-permute", "async-start", "async-update",
+    "async-done", "all-reduce-start", "all-reduce-done", "all-gather-start",
+    "all-gather-done", "collective-permute-start", "collective-permute-done",
+    "add-dependency", "after-all", "get-dimension-size", "partition-id",
+    "replica-id", "send", "recv", "send-done", "recv-done", "infeed",
+    "outfeed", "domain", "opt-barrier",
 })
+
+
+_OPCODE_RE = re.compile(r" ([a-z][a-z0-9\-]*(?:\.\d+)?)\(")
+_SCALAR_TYPE_RE = re.compile(r"^[a-z][a-z0-9]*\[\]")
 
 
 def assert_epilogues_fused(fn, *args) -> dict:
     """Compile ``fn(*args)`` and assert every elementwise epilogue (the
-    scale/bias multiplies+adds, ReLU maximums, residual adds) was fused
-    into a larger region rather than left as a top-level HLO instruction —
-    the guarantee the reference's hand-built cudnn graph provides.
+    scale/bias multiplies+adds, ReLU maximums and their select/compare
+    backward, residual adds) was fused into a larger region rather than
+    left as a top-level HLO instruction — the guarantee the reference's
+    hand-built cudnn graph provides.
 
-    Returns ``{"fusions": n, "loose_elementwise": []}``; raises
-    AssertionError listing offenders otherwise. Works on any backend
-    (tests run it on CPU; the TPU compiler fuses at least as aggressively).
+    Any ENTRY-computation instruction whose opcode is not in
+    ``_NON_EPILOGUE_OPS`` (structure, data movement, compute primitives,
+    control flow, collectives) is flagged; scalar results are exempt (a
+    loss's ``1/N`` factor costs nothing). Returns ``{"fusions": n,
+    "loose_elementwise": []}``; raises AssertionError listing offenders
+    otherwise. Works on any backend (tests run it on CPU; the TPU compiler
+    fuses at least as aggressively).
     """
     compiled = jax.jit(fn).lower(*args).compile()
     text = compiled.as_text()
     loose: list = []
     fusions = 0
+    scanned = 0
     in_entry = False
     for line in text.splitlines():
         s = line.strip()
@@ -142,23 +162,30 @@ def assert_epilogues_fused(fn, *args) -> dict:
             continue
         if not in_entry or "=" not in s:
             continue
-        # "%name = type op(...)" — op is the token after the type
-        rhs = s.split("=", 1)[1].strip()
-        parts = rhs.split(" ")
-        if len(parts) < 2:
+        # "%name = <type> <opcode>(<operands>), <attrs>". The type may be a
+        # tuple containing spaces (e.g. async copies), so locate the opcode
+        # as the first space-preceded lowercase token followed by "(" —
+        # layout annotations like T(8,128) are colon/paren-preceded and
+        # never match.
+        rhs = s.split("=", 1)[1]
+        m = _OPCODE_RE.search(rhs)
+        if m is None:
             continue
+        scanned += 1
         # scalar results (e.g. "f32[]", a loss's 1/N factor) cost nothing
         # and are not the bandwidth epilogues this guard protects
-        if "[]" in parts[0]:
+        if _SCALAR_TYPE_RE.match(rhs[: m.start()].strip()):
             continue
-        op = parts[1].split("(")[0]
+        op = m.group(1).split(".")[0]
         if op.startswith("fusion"):
             fusions += 1
             continue
-        base = op.split(".")[0]
-        if base in ("add", "multiply", "subtract", "maximum", "minimum",
-                    "divide", "exponential", "rsqrt"):
+        if op not in _NON_EPILOGUE_OPS:
             loose.append(s)
+    assert scanned > 0, (
+        "HLO parser saw no ENTRY instructions — compiled.as_text() format "
+        "changed; the fusion guard is not checking anything"
+    )
     assert not loose, (
         "elementwise epilogues escaped fusion at HLO top level:\n  "
         + "\n  ".join(loose[:10])

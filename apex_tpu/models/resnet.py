@@ -76,18 +76,24 @@ class Bottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x, use_running_average: bool = False):
+        return self._forward(x, self.norm, use_running_average)
+
+    def _forward(self, x, norm, use_running_average):
+        """Block body, parameterized on the norm factory so subclasses
+        (contrib.bottleneck.FastBottleneck) can pin a different norm
+        without duplicating the structure."""
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         out = self.filters * self.expansion
         residual = x
         y = conv(self.filters, (1, 1), name="conv1")(x)
-        y = self.norm(fuse_relu=True, name="bn1")(y, use_running_average)
+        y = norm(fuse_relu=True, name="bn1")(y, use_running_average)
         y = conv(self.filters, (3, 3), strides=self.strides, padding=1, name="conv2")(y)
-        y = self.norm(fuse_relu=True, name="bn2")(y, use_running_average)
+        y = norm(fuse_relu=True, name="bn2")(y, use_running_average)
         y = conv(out, (1, 1), name="conv3")(y)
-        y = self.norm(name="bn3")(y, use_running_average)
+        y = norm(name="bn3")(y, use_running_average)
         if residual.shape != y.shape:
             residual = conv(out, (1, 1), strides=self.strides, name="conv_ds")(x)
-            residual = self.norm(name="bn_ds")(residual, use_running_average)
+            residual = norm(name="bn_ds")(residual, use_running_average)
         return jax.nn.relu(y + residual)
 
 
@@ -160,13 +166,14 @@ ResNet152 = partial(_resnet, (3, 8, 36, 3), Bottleneck)
 
 
 def _frozen_resnet(stage_sizes, **kw) -> ResNet:
-    """ResNet built from :class:`apex_tpu.contrib.bottleneck.FastBottleneck`
-    — frozen-BN blocks with the fused conv+scale/bias+ReLU+residual chain,
-    the detection-backbone configuration of the reference's fast_bottleneck
-    extension (apex/contrib/bottleneck/bottleneck.py)."""
-    from apex_tpu.contrib.bottleneck import FastBottleneck
+    """ResNet with every BN frozen to per-channel scale/bias — the
+    detection-backbone configuration of the reference's fast_bottleneck
+    extension (apex/contrib/bottleneck/bottleneck.py): FastBottleneck
+    blocks plus a frozen stem norm."""
+    from apex_tpu.contrib.bottleneck import FastBottleneck, FrozenBatchNorm
 
-    return ResNet(stage_sizes=stage_sizes, block_cls=FastBottleneck, **kw)
+    return ResNet(stage_sizes=stage_sizes, block_cls=FastBottleneck,
+                  norm_cls=FrozenBatchNorm, **kw)
 
 
 ResNet50Frozen = partial(_frozen_resnet, (3, 4, 6, 3))

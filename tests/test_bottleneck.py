@@ -30,7 +30,7 @@ def test_fold_batchnorm_matches_bn_inference():
 
 
 def test_frozen_bn_module_applies_folded_params():
-    m = FrozenBatchNorm(features=4, fuse_relu=True)
+    m = FrozenBatchNorm(fuse_relu=True)
     x = jnp.asarray([[-1.0, 0.5, 2.0, -3.0]])
     params = {"params": {"scale": jnp.asarray([2.0, 2.0, 2.0, 2.0]),
                          "bias": jnp.asarray([1.0, -2.0, 0.0, 0.0])}}
@@ -90,22 +90,38 @@ def test_epilogues_fused_train_step(block_and_inputs):
     def loss(p):
         return jnp.mean(block.apply(p, x) ** 2)
 
-    assert_epilogues_fused(jax.value_and_grad(loss), params)
+    stats = assert_epilogues_fused(jax.value_and_grad(loss), params)
+    assert stats["fusions"] >= 1
+
+
+def test_fastbottleneck_freezes_even_with_live_norm_passed():
+    """ResNet's block wiring always passes a live-norm factory; the block
+    must ignore it — frozen-by-construction is the contract."""
+    from functools import partial
+
+    from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+    block = FastBottleneck(filters=4, norm=partial(SyncBatchNorm, channel_last=True))
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 8))
+    variables = block.init(jax.random.PRNGKey(1), x)
+    assert set(variables.keys()) == {"params"}  # no batch_stats: frozen
+    assert set(variables["params"]["bn1"].keys()) == {"scale", "bias"}
 
 
 def test_resnet_frozen_wiring():
-    """ResNet50Frozen builds with FastBottleneck blocks: bn leaves are
-    scale/bias pairs only (no running stats), and forward runs."""
+    """ResNet50Frozen builds fully frozen: every bn (stem included) is a
+    scale/bias pair only — no batch_stats collection exists — and forward
+    runs in both train and eval modes without mutability."""
     from apex_tpu.models.resnet import ResNet50Frozen
 
     model = ResNet50Frozen(num_classes=10, width=8, stem_pool=False)
     x = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 32, 3))
     variables = model.init(jax.random.PRNGKey(1), x)
+    assert set(variables.keys()) == {"params"}  # no batch_stats anywhere
     blk = variables["params"]["layer1_0"]
     assert set(blk["bn1"].keys()) == {"scale", "bias"}
+    assert set(variables["params"]["bn1"].keys()) == {"scale", "bias"}
     assert "conv1" in blk and "conv_ds" in blk
-    # stem BN stays live (the reference freezes only backbone blocks);
-    # eval mode reads its running stats
-    logits = model.apply(variables, x, True, mutable=False)
+    logits = model.apply(variables, x, mutable=False)
     assert logits.shape == (1, 10)
     assert np.isfinite(np.asarray(logits)).all()
