@@ -52,9 +52,12 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
     mesh = mesh_lib.make_virtual_mesh(
         n_dev, tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp)
     try:
+        # layer count must divide by pp for the stage shards; record the
+        # effective value so ramped sweeps are labeled with what actually ran
+        eff_layers = max(layers, pp) // pp * pp
         cfg = GPTConfig(
             vocab_size=vocab, hidden_size=hidden,
-            num_layers=max(layers, pp) // pp * pp,
+            num_layers=eff_layers,
             num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
             axis=mesh_lib.AXIS_MODEL if tp > 1 else None,
             compute_dtype=jnp.bfloat16, remat=True,
@@ -118,7 +121,7 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
         loss_val = float(loss)  # host fetch forces the whole chain
         dt = (time.perf_counter() - t0) / steps
         return {
-            "config": {"dp": dp, "tp": tp, "pp": pp},
+            "config": {"dp": dp, "tp": tp, "pp": pp, "layers": eff_layers},
             "avg_iteration_time_s": round(dt, 4),
             "tokens_per_sec": round(batch * seq / dt, 1),
             "loss": round(loss_val, 4),
@@ -127,28 +130,70 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
         mesh_lib.destroy_model_parallel()
 
 
+def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
+             steps, output_dir=None, grid=GRID):
+    """Sweep ``grid`` × ``layers_list`` (the reference ramps layer counts per
+    config, gpt_scaling_test.py:53-57). One JSON artifact per (config,
+    layers) when ``output_dir`` is set, plus a combined ``scaling_table``;
+    returns the result rows."""
+    rows = []
+    for dp, tp, pp in grid:
+        for layers in layers_list:
+            res = run_config(
+                dp, tp, pp, hidden=hidden, layers=layers, heads=heads,
+                vocab=vocab, seq=seq, micro_batch=micro_batch,
+                n_micro=n_micro, steps=steps)
+            if res is None:
+                res = {"config": {"dp": dp, "tp": tp, "pp": pp},
+                       "skipped": "not enough devices"}
+            # run_config records the effective (pp-divisible) layer count;
+            # only skipped rows fall back to the requested one
+            res["config"].setdefault("layers", layers)
+            rows.append(res)
+            print(json.dumps(res), flush=True)
+            if output_dir:
+                os.makedirs(output_dir, exist_ok=True)
+                name = f"scaling_dp{dp}_tp{tp}_pp{pp}_l{layers}.json"
+                with open(os.path.join(output_dir, name), "w") as f:
+                    json.dump(res, f, indent=1)
+    if output_dir:
+        with open(os.path.join(output_dir, "scaling_table.json"), "w") as f:
+            json.dump(rows, f, indent=1)
+    # the human-readable table the reference prints as
+    # "Average Iteration Time" lines (gpt_scaling_test.py:64-70)
+    hdr = f"{'dp':>3} {'tp':>3} {'pp':>3} {'layers':>6} {'iter_s':>9} {'tok/s':>10}"
+    print(hdr)
+    for r in rows:
+        c = r["config"]
+        if "skipped" in r:
+            print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
+                  f"{c.get('layers', '-'):>6} {'skipped':>9}")
+        else:
+            print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} {c['layers']:>6} "
+                  f"{r['avg_iteration_time_s']:>9.4f} {r['tokens_per_sec']:>10.1f}")
+    return rows
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--hidden", type=int, default=128)
-    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--layers", type=str, default="4",
+                   help="comma-separated layer counts to ramp per config")
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--vocab", type=int, default=2048)
     p.add_argument("--seq", type=int, default=128)
     p.add_argument("--micro-batch", type=int, default=1)
     p.add_argument("--num-microbatches", type=int, default=2)
     p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--output-dir", type=str, default=None,
+                   help="write one JSON artifact per config plus scaling_table.json")
     args = p.parse_args()
-    for dp, tp, pp in GRID:
-        res = run_config(
-            dp, tp, pp, hidden=args.hidden, layers=args.layers,
-            heads=args.heads, vocab=args.vocab, seq=args.seq,
-            micro_batch=args.micro_batch, n_micro=args.num_microbatches,
-            steps=args.steps)
-        if res is None:
-            print(json.dumps({"config": {"dp": dp, "tp": tp, "pp": pp},
-                              "skipped": "not enough devices"}))
-        else:
-            print(json.dumps(res))
+    run_grid(
+        hidden=args.hidden,
+        layers_list=[int(x) for x in args.layers.split(",")],
+        heads=args.heads, vocab=args.vocab, seq=args.seq,
+        micro_batch=args.micro_batch, n_micro=args.num_microbatches,
+        steps=args.steps, output_dir=args.output_dir)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,26 @@ def _load_harness():
     return mod
 
 
+def test_run_grid_writes_artifacts(tmp_path):
+    """The reference-grid sweep (gpt_scaling_test.py:49-70 parity): one JSON
+    artifact per config plus the combined table, via one call."""
+    import json
+
+    harness = _load_harness()
+    rows = harness.run_grid(
+        hidden=32, layers_list=[2], heads=4, vocab=64, seq=16,
+        micro_batch=1, n_micro=2, steps=1, output_dir=str(tmp_path),
+        grid=[(2, 1, 1), (1, 1, 2)])
+    assert len(rows) == 2
+    assert (tmp_path / "scaling_table.json").exists()
+    per_config = sorted(p.name for p in tmp_path.glob("scaling_dp*_l2.json"))
+    assert per_config == ["scaling_dp1_tp1_pp2_l2.json", "scaling_dp2_tp1_pp1_l2.json"]
+    table = json.loads((tmp_path / "scaling_table.json").read_text())
+    for row in table:
+        assert "skipped" in row or row["tokens_per_sec"] > 0
+        assert row["config"]["layers"] == 2
+
+
 def test_run_config_smoke():
     harness = _load_harness()
     res = harness.run_config(
@@ -25,7 +45,7 @@ def test_run_config_smoke():
         micro_batch=1, n_micro=2, steps=1)
     if res is None:
         pytest.skip("fewer than 4 devices on this platform")
-    assert res["config"] == {"dp": 2, "tp": 1, "pp": 2}
+    assert res["config"] == {"dp": 2, "tp": 1, "pp": 2, "layers": 2}
     assert res["avg_iteration_time_s"] > 0
     assert res["tokens_per_sec"] > 0
     import numpy as np
