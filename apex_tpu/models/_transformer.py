@@ -28,6 +28,28 @@ from apex_tpu.utils.nn import inverted_dropout
 Params = Dict[str, Any]
 
 
+def _remat_policy(name: Optional[str]):
+    """Selective activation-checkpoint policies (reference: the sharded
+    activation buffer knob of tensor_parallel/random.py:45-76 — the
+    memory/recompute dial, redesigned as jax.checkpoint policies):
+
+    - None/"full": recompute everything (lowest memory);
+    - "save_attn": save the flash-attention kernel outputs (tagged
+      "flash_out"/"flash_lse" in ops/flash_attention._flash_fwd) so
+      backward skips re-running the attention forward — the layer's most
+      FLOP-expensive recompute — for O(b*h*s*d) extra memory per layer;
+    - "dots": XLA's dots_with_no_batch_dims_saveable (save GEMM outputs).
+    """
+    if name in (None, "full"):
+        return None
+    if name == "save_attn":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat_policy {name!r}")
+
+
 def stack_specs(spec_tree):
     """Prefix each PartitionSpec with the stacked (num_layers) dim."""
     return jax.tree.map(
@@ -184,6 +206,9 @@ class TransformerBase:
             return self._layer(p, h, k, attn_bias), None
 
         if self.cfg.remat:
-            body = jax.checkpoint(body, prevent_cse=False)
+            body = jax.checkpoint(
+                body, prevent_cse=False,
+                policy=_remat_policy(getattr(self.cfg, "remat_policy", None)),
+            )
         h, _ = lax.scan(body, h, (layers, keys))
         return h

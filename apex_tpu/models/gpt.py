@@ -61,6 +61,9 @@ class GPTConfig:
     hidden_dropout: float = 0.1
     init_method_std: float = 0.02
     remat: bool = True  # activation checkpointing per layer
+    # selective checkpoint policy: None/"full" | "save_attn" | "dots"
+    # (models/_transformer._remat_policy)
+    remat_policy: Optional[str] = None
     attention_impl: str = "auto"  # flash_attention impl switch
     # chunked fused LM-head CE (ops/lm_head_loss): avoids materializing the
     # (tokens, vocab) logits when computing the loss. Serial (axis=None) only;

@@ -30,6 +30,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -319,6 +320,12 @@ def _flash_fwd(q, k, v, bias, offsets, *, scale, causal, blk_q, blk_k):
         ],
         interpret=_interpret(),
     )(*args)
+    # Named for selective activation checkpointing: a remat policy saving
+    # these (e.g. GPTConfig.remat_policy="save_attn") keeps the kernel's
+    # output + logsumexp so backward never re-runs the forward kernel —
+    # O(b*h*s*d) memory buys back the most expensive recompute in the layer.
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, lse
 
 

@@ -31,7 +31,7 @@ if os.environ.get("JAX_PLATFORMS"):
 import jax.numpy as jnp
 
 
-def build(policy_level: str, impl: str):
+def build(policy_level: str, impl: str, remat_policy=None):
     import optax
 
     from apex_tpu import amp
@@ -49,6 +49,7 @@ def build(policy_level: str, impl: str):
         axis=None,
         compute_dtype=jnp.bfloat16 if fused else jnp.float32,
         remat=True,
+        remat_policy=remat_policy,
         attention_impl=impl,
         # fused chunked LM-head CE: ~6% throughput and ~0.8 GB less peak HBM
         # (survives pressure from co-tenants on the shared chip) — PERF_NOTES.md
@@ -61,8 +62,7 @@ def build(policy_level: str, impl: str):
     params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
     opt_state = mp_opt.init(params)
 
-    @jax.jit
-    def train_step(params, opt_state, tokens, targets):
+    def step(params, opt_state, tokens, targets):
         def scaled_loss(p):
             return mp_opt.scale_loss(model.loss(p, tokens, targets), opt_state)
 
@@ -72,40 +72,92 @@ def build(policy_level: str, impl: str):
         )
         return new_params, new_state, loss_s, metrics
 
-    return train_step, params, opt_state
+    return step, params, opt_state
 
 
-def measure(train_step, params, opt_state, batch, seq, steps=10) -> float:
+def measure(step, params, opt_state, batch, seq, steps=10, scan_chunk=4) -> float:
+    """Time ``steps`` train steps, dispatched as scanned chunks of
+    ``scan_chunk`` steps per program when possible.
+
+    The scan matters twice over through the axon tunnel: it amortizes
+    per-dispatch overhead, and — since the tunnel backend rejects buffer
+    donation — it is the only way the params/optimizer state update
+    in-place (the scan carry lives inside one program) instead of being
+    rewritten to fresh buffers every step. ~5% end-to-end (PERF_NOTES.md).
+    Falls back to single-step dispatch (scan_chunk=1) if the scanned
+    program does not fit.
+    """
+    from jax import lax
+
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 50304)
     targets = jnp.roll(tokens, -1, axis=-1)
+
+    if scan_chunk > 1:
+
+        @jax.jit
+        def run_chunk(params, opt_state, tokens, targets):
+            def body(carry, _):
+                p, s = carry
+                p, s, loss, _ = step(p, s, tokens, targets)
+                return (p, s), loss
+
+            (params, opt_state), losses = lax.scan(
+                body, (params, opt_state), None, length=scan_chunk)
+            return params, opt_state, losses[-1]
+
+    else:
+
+        @jax.jit
+        def run_chunk(params, opt_state, tokens, targets):
+            p, s, loss, _ = step(params, opt_state, tokens, targets)
+            return p, s, loss
+
+    # round the requested step count up to whole chunks (never time fewer
+    # steps than asked); normalization below uses the actual count run
+    n_chunks = max(1, -(-steps // scan_chunk))
     # warmup / compile. Through remote-device tunnels (axon),
     # block_until_ready can ack dispatch rather than execution, so force a
     # device->host transfer of a value that depends on the whole chain.
-    params, opt_state, loss, _ = train_step(params, opt_state, tokens, targets)
+    params, opt_state, loss = run_chunk(params, opt_state, tokens, targets)
     float(loss)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss, _ = train_step(params, opt_state, tokens, targets)
+    for _ in range(n_chunks):
+        params, opt_state, loss = run_chunk(params, opt_state, tokens, targets)
     # the final loss depends on every prior step's params: fetching it to the
     # host forces full execution before the clock stops.
     loss_val = float(loss)
-    dt = (time.perf_counter() - t0) / steps
+    dt = (time.perf_counter() - t0) / (n_chunks * scan_chunk)
     assert jnp.isfinite(loss_val), "non-finite loss in bench"
     return batch * seq / dt
 
 
 def measure_resilient(level, impl, batch, seq, steps):
     """The chip is shared: co-tenant HBM pressure can OOM a config that
-    normally fits. Halve the batch (tokens/s is per-token normalized) rather
-    than lose the round's record."""
+    normally fits. Degrade gracefully — selective remat → full remat,
+    scanned dispatch → per-step dispatch, then halve the batch (tokens/s is
+    per-token normalized) — rather than lose the round's record."""
+    # (remat_policy, scan_chunk) from fastest to most memory-frugal.
+    # save_attn keeps the flash kernel outputs so backward skips the
+    # attention recompute (~5% when HBM allows it).
+    ladder = ([("save_attn", 4), (None, 4), (None, 1)] if level == "O2"
+              else [(None, 4), (None, 1)])
+    last_oom = None
     while True:
-        try:
-            return measure(*build(level, impl), batch, seq, steps), batch
-        except Exception as e:  # noqa: BLE001 - jaxlib error types vary
-            if "RESOURCE_EXHAUSTED" not in str(e) or batch <= 1:
-                raise
-            batch //= 2
-            print(f"{level}: OOM, retrying at batch {batch}", file=sys.stderr)
+        for remat_policy, scan_chunk in ladder:
+            try:
+                tps = measure(*build(level, impl, remat_policy), batch, seq,
+                              steps, scan_chunk=scan_chunk)
+                return tps, batch
+            except Exception as e:  # noqa: BLE001 - jaxlib error types vary
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                last_oom = e
+                print(f"{level}: OOM at remat_policy={remat_policy} "
+                      f"scan={scan_chunk}, batch {batch}", file=sys.stderr)
+        if batch <= 1:
+            # keep the jaxlib allocator diagnostics on the chained cause
+            raise RuntimeError(f"{level}: OOM even at batch 1") from last_oom
+        batch //= 2
 
 
 def main():

@@ -149,11 +149,22 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             # run_config records the effective (pp-divisible) layer count;
             # only skipped rows fall back to the requested one
             res["config"].setdefault("layers", layers)
+            eff = res["config"]["layers"]
+            if eff != layers:
+                res["config"]["requested_layers"] = layers
+            if any(r["config"] == res["config"] for r in rows):
+                # two requested counts rounded to the same effective config;
+                # don't record the same measurement twice under two labels
+                print(json.dumps({"config": {"dp": dp, "tp": tp, "pp": pp,
+                                             "requested_layers": layers},
+                                  "skipped": f"duplicate of layers={eff}"}),
+                      flush=True)
+                continue
             rows.append(res)
             print(json.dumps(res), flush=True)
             if output_dir:
                 os.makedirs(output_dir, exist_ok=True)
-                name = f"scaling_dp{dp}_tp{tp}_pp{pp}_l{layers}.json"
+                name = f"scaling_dp{dp}_tp{tp}_pp{pp}_l{eff}.json"
                 with open(os.path.join(output_dir, name), "w") as f:
                     json.dump(res, f, indent=1)
     if output_dir:
