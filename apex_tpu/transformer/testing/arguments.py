@@ -1,62 +1,400 @@
 """Megatron-style argument parser (reference: apex/transformer/testing/
-arguments.py — 808 LoC of argparse groups; this keeps the knobs the TPU
-framework actually consumes, same names and defaults so reference launch
-scripts port by search-and-replace).
+arguments.py, 808 LoC).
+
+Full flag-surface parity: every flag the reference parser accepts parses
+here with the same name and default, grouped the same way, so reference
+launch scripts and ported harness code run unchanged. Semantics on TPU:
+
+- flags that map to real knobs in this framework (model dims, parallel
+  sizes, precision, loss scaling, optimizer, activation checkpointing)
+  feed ``GPTConfig``/``initialize_model_parallel``/``get_policy`` directly;
+- CUDA-era mechanism flags (``--DDP-impl``, ``--empty-unused-memory-level``,
+  ``--no-contiguous-buffers-in-local-ddp``, …) are **accepted and
+  recorded** — their mechanics are XLA's job here — so scripts that pass
+  them don't crash;
+- the reference's post-parse derivations are preserved: rank/world-size
+  from the environment, tp/pp clamping and divisibility checks,
+  ``data_parallel_size``, deprecated-flag errors (``--batch-size``,
+  ``--warmup``, ``--model-parallel-size``), ``--checkpoint-activations``
+  rewriting to the uniform activation-checkpoint method, precision
+  ``params_dtype`` selection, virtual-pipeline sizing, and vocab padding
+  to ``--make-vocab-size-divisible-by`` × tp.
+
+Deviations (documented): when ``WORLD_SIZE`` is not in the environment
+(no launcher — e.g. a single JAX process driving a mesh), world size
+defaults to tp × pp instead of 1, so requested parallel sizes are kept and
+the mesh builder validates against real devices later. ``parse_args``
+also accepts an explicit argv list (first positional or ``args=``) for
+tests; the reference reads ``sys.argv`` only.
 """
 
 from __future__ import annotations
 
 import argparse
-from typing import Optional, Sequence
+import os
+from typing import Callable, Dict, Optional, Sequence
 
 
-def parse_args(args: Optional[Sequence[str]] = None) -> argparse.Namespace:
-    p = argparse.ArgumentParser(description="apex_tpu Megatron-style arguments")
+def _network_size(p):
+    g = p.add_argument_group("network size")
+    g.add_argument("--num-layers", type=int, default=None)
+    g.add_argument("--hidden-size", type=int, default=None)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--num-attention-heads", type=int, default=None)
+    g.add_argument("--kv-channels", type=int, default=None)
+    g.add_argument("--max-position-embeddings", type=int, default=None)
+    g.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    g.add_argument("--apply-residual-connection-post-layernorm",
+                   action="store_true")
+    g.add_argument("--openai-gelu", action="store_true")
+    g.add_argument("--onnx-safe", type=bool, required=False)
+    g.add_argument("--bert-no-binary-head", action="store_false",
+                   dest="bert_binary_head")
+    # this framework's knob (the reference gets vocab from the tokenizer):
+    # direct vocab size for tokenizer-less harness runs
+    g.add_argument("--vocab-size", type=int, default=None)
 
-    g = p.add_argument_group("model")
-    g.add_argument("--num-layers", type=int, default=24)
-    g.add_argument("--hidden-size", type=int, default=1024)
-    g.add_argument("--num-attention-heads", type=int, default=16)
-    g.add_argument("--seq-length", type=int, default=1024)
-    g.add_argument("--max-position-embeddings", type=int, default=1024)
-    g.add_argument("--vocab-size", type=int, default=50304)
+
+def _logging(p):
+    g = p.add_argument_group("logging")
+    g.add_argument("--log-params-norm", action="store_true")
+    g.add_argument("--log-num-zeros-in-grad", action="store_true")
+    g.add_argument("--tensorboard-log-interval", type=int, default=1)
+    g.add_argument("--tensorboard-queue-size", type=int, default=1000)
+    g.add_argument("--log-timers-to-tensorboard", action="store_true")
+    g.add_argument("--log-batch-size-to-tensorboard", action="store_true")
+    g.add_argument("--no-log-learnig-rate-to-tensorboard",
+                   action="store_false",
+                   dest="log_learning_rate_to_tensorboard")
+    g.add_argument("--no-log-loss-scale-to-tensorboard",
+                   action="store_false", dest="log_loss_scale_to_tensorboard")
+    g.add_argument("--log-validation-ppl-to-tensorboard", action="store_true")
+    g.add_argument("--log-memory-to-tensorboard", action="store_true")
+
+
+def _regularization(p):
+    g = p.add_argument_group("regularization")
+    g.add_argument("--attention-dropout", type=float, default=0.1)
     g.add_argument("--hidden-dropout", type=float, default=0.1)
-    g.add_argument("--init-method-std", type=float, default=0.02)
-
-    g = p.add_argument_group("parallel")
-    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
-    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
-    g.add_argument("--virtual-pipeline-model-parallel-size", type=int, default=None)
-    g.add_argument("--pipeline-model-parallel-split-rank", type=int, default=None)
-    g.add_argument("--context-parallel-size", type=int, default=1)
-
-    g = p.add_argument_group("batch")
-    g.add_argument("--micro-batch-size", type=int, default=1)
-    g.add_argument("--global-batch-size", type=int, default=None)
-    g.add_argument("--rampup-batch-size", nargs=3, type=int, default=None,
-                   metavar=("START", "INCREMENT", "SAMPLES"))
-
-    g = p.add_argument_group("precision")
-    g.add_argument("--fp16", action="store_true")
-    g.add_argument("--bf16", action="store_true")
-    g.add_argument("--loss-scale", type=float, default=None,
-                   help="static loss scale; None selects dynamic")
-    g.add_argument("--initial-loss-scale", type=float, default=2.0 ** 16)
-    g.add_argument("--loss-scale-window", type=int, default=2000)
-
-    g = p.add_argument_group("training")
-    g.add_argument("--lr", type=float, default=1e-4)
     g.add_argument("--weight-decay", type=float, default=0.01)
     g.add_argument("--clip-grad", type=float, default=1.0)
-    g.add_argument("--train-iters", type=int, default=100)
-    g.add_argument("--seed", type=int, default=1234)
-    g.add_argument("--optimizer", default="adam",
-                   choices=["adam", "lamb", "sgd", "novograd", "adagrad"])
-    g.add_argument("--recompute-activations", action="store_true")
+    g.add_argument("--adam-beta1", type=float, default=0.9)
+    g.add_argument("--adam-beta2", type=float, default=0.999)
+    g.add_argument("--adam-eps", type=float, default=1e-08)
+    g.add_argument("--sgd-momentum", type=float, default=0.9)
 
-    ns = p.parse_args(args)
-    if ns.global_batch_size is None:
-        ns.global_batch_size = ns.micro_batch_size
+
+def _training(p):
+    g = p.add_argument_group("training")
+    g.add_argument("--micro-batch-size", type=int, default=None)
+    g.add_argument("--batch-size", type=int, default=None,
+                   help="deprecated: use --micro-batch-size")
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs="*", default=None)
+    g.add_argument("--checkpoint-activations", action="store_true")
+    g.add_argument("--distribute-checkpointed-activations",
+                   action="store_true")
+    g.add_argument("--activations-checkpoint-method", type=str, default=None,
+                   choices=["uniform", "block"])
+    g.add_argument("--activations-checkpoint-num-layers", type=int, default=1)
+    g.add_argument("--train-iters", type=int, default=None)
+    g.add_argument("--train-samples", type=int, default=None)
+    g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--exit-interval", type=int, default=None)
+    g.add_argument("--exit-duration-in-mins", type=int, default=None)
+    g.add_argument("--tensorboard-dir", type=str, default=None)
+    g.add_argument("--no-masked-softmax-fusion", action="store_false",
+                   dest="masked_softmax_fusion")
+    g.add_argument("--no-bias-gelu-fusion", action="store_false",
+                   dest="bias_gelu_fusion")
+    g.add_argument("--no-bias-dropout-fusion", action="store_false",
+                   dest="bias_dropout_fusion")
+    g.add_argument("--optimizer", type=str, default="adam",
+                   choices=["adam", "sgd", "lamb", "novograd", "adagrad"])
+    g.add_argument("--dataloader-type", type=str, default=None,
+                   choices=["single", "cyclic"])
+    g.add_argument("--no-async-tensor-model-parallel-allreduce",
+                   action="store_false",
+                   dest="async_tensor_model_parallel_allreduce")
+
+
+def _initialization(p):
+    g = p.add_argument_group("initialization")
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--init-method-std", type=float, default=0.02)
+    g.add_argument("--init-method-xavier-uniform", action="store_true")
+
+
+def _learning_rate(p):
+    g = p.add_argument_group("learning rate")
+    g.add_argument("--lr", type=float, default=None)
+    g.add_argument("--lr-decay-style", type=str, default="linear",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument("--lr-decay-iters", type=int, default=None)
+    g.add_argument("--lr-decay-samples", type=int, default=None)
+    g.add_argument("--lr-warmup-fraction", type=float, default=None)
+    g.add_argument("--lr-warmup-iters", type=int, default=0)
+    g.add_argument("--lr-warmup-samples", type=int, default=0)
+    g.add_argument("--warmup", type=int, default=None,
+                   help="deprecated: use --lr-warmup-fraction")
+    g.add_argument("--min-lr", type=float, default=0.0)
+    g.add_argument("--override-lr-scheduler", action="store_true")
+    g.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
+
+
+def _checkpointing(p):
+    g = p.add_argument_group("checkpointing")
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--save-interval", type=int, default=None)
+    g.add_argument("--no-save-optim", action="store_true", default=None)
+    g.add_argument("--no-save-rng", action="store_true", default=None)
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--no-load-optim", action="store_true", default=None)
+    g.add_argument("--no-load-rng", action="store_true", default=None)
+    g.add_argument("--finetune", action="store_true")
+
+
+def _mixed_precision(p):
+    g = p.add_argument_group("mixed precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None)
+    g.add_argument("--initial-loss-scale", type=float, default=2 ** 32)
+    g.add_argument("--min-loss-scale", type=float, default=1.0)
+    g.add_argument("--loss-scale-window", type=float, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--fp32-residual-connection", action="store_true")
+    g.add_argument("--no-query-key-layer-scaling", action="store_false",
+                   dest="apply_query_key_layer_scaling")
+    g.add_argument("--attention-softmax-in-fp32", action="store_true")
+    g.add_argument("--accumulate-allreduce-grads-in-fp32",
+                   action="store_true")
+    g.add_argument("--fp16-lm-cross-entropy", action="store_true")
+
+
+def _distributed(p):
+    g = p.add_argument_group("distributed")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-split-rank", type=int,
+                   default=None)
+    g.add_argument("--model-parallel-size", type=int, default=None,
+                   help="deprecated: use --tensor-model-parallel-size")
+    g.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
+                   default=None)
+    g.add_argument("--context-parallel-size", type=int, default=1,
+                   help="sequence/context parallelism (TPU framework knob; "
+                        "no reference equivalent)")
+    g.add_argument("--distributed-backend", default="nccl",
+                   choices=["nccl", "gloo", "xla"])
+    g.add_argument("--DDP-impl", default="local", choices=["local", "torch"])
+    g.add_argument("--no-contiguous-buffers-in-local-ddp",
+                   action="store_false",
+                   dest="use_contiguous_buffers_in_local_ddp")
+    g.add_argument("--no-scatter-gather-tensors-in-pipeline",
+                   action="store_false",
+                   dest="scatter_gather_tensors_in_pipeline")
+    g.add_argument("--local_rank", type=int, default=None)
+    g.add_argument("--lazy-mpu-init", type=bool, required=False)
+    g.add_argument("--use-cpu-initialization", action="store_true",
+                   default=None)
+    g.add_argument("--cpu-offload", action="store_true", default=False)
+    g.add_argument("--empty-unused-memory-level", default=0, type=int,
+                   choices=[0, 1, 2])
+
+
+def _validation(p):
+    g = p.add_argument_group("validation")
+    g.add_argument("--eval-iters", type=int, default=100)
+    g.add_argument("--eval-interval", type=int, default=1000)
+
+
+def _data(p):
+    g = p.add_argument_group("data and dataloader")
+    g.add_argument("--data-path", nargs="*", default=None)
+    g.add_argument("--split", type=str, default="969, 30, 1")
+    g.add_argument("--vocab-file", type=str, default=None)
+    g.add_argument("--merge-file", type=str, default=None)
+    g.add_argument("--vocab-extra-ids", type=int, default=0)
+    g.add_argument("--seq-length", type=int, default=None)
+    g.add_argument("--encoder-seq-length", type=int, default=None)
+    g.add_argument("--decoder-seq-length", type=int, default=None)
+    g.add_argument("--retriever-seq-length", type=int, default=256)
+    g.add_argument("--sample-rate", type=float, default=1.0)
+    g.add_argument("--mask-prob", type=float, default=0.15)
+    g.add_argument("--short-seq-prob", type=float, default=0.1)
+    g.add_argument("--mmap-warmup", action="store_true")
+    g.add_argument("--num-workers", type=int, default=2)
+    g.add_argument("--tokenizer-type", type=str, default=None,
+                   choices=["BertWordPieceLowerCase", "BertWordPieceCase",
+                            "GPT2BPETokenizer"])
+    g.add_argument("--data-impl", type=str, default="infer",
+                   choices=["lazy", "cached", "mmap", "infer"])
+    g.add_argument("--reset-position-ids", action="store_true")
+    g.add_argument("--reset-attention-mask", action="store_true")
+    g.add_argument("--eod-mask-loss", action="store_true")
+
+
+def _autoresume(p):
+    g = p.add_argument_group("autoresume")
+    g.add_argument("--adlr-autoresume", action="store_true")
+    g.add_argument("--adlr-autoresume-interval", type=int, default=1000)
+
+
+def _biencoder(p):
+    g = p.add_argument_group("biencoder")
+    g.add_argument("--ict-head-size", type=int, default=None)
+    g.add_argument("--biencoder-projection-dim", type=int, default=0)
+    g.add_argument("--biencoder-shared-query-context-model",
+                   action="store_true")
+    g.add_argument("--ict-load", type=str, default=None)
+    g.add_argument("--bert-load", type=str, default=None)
+    g.add_argument("--titles-data-path", type=str, default=None)
+    g.add_argument("--query-in-block-prob", type=float, default=0.1)
+    g.add_argument("--use-one-sent-docs", action="store_true")
+    g.add_argument("--evidence-data-path", type=str, default=None)
+    g.add_argument("--retriever-report-topk-accuracies", nargs="+", type=int,
+                   default=[])
+    g.add_argument("--retriever-score-scaling", action="store_true")
+    g.add_argument("--block-data-path", type=str, default=None)
+    g.add_argument("--embedding-path", type=str, default=None)
+    g.add_argument("--indexer-batch-size", type=int, default=128)
+    g.add_argument("--indexer-log-interval", type=int, default=1000)
+
+
+def _vision(p):
+    g = p.add_argument_group("vision")
+    g.add_argument("--num-classes", type=int, default=1000)
+    g.add_argument("--img-dim", type=int, default=224)
+    g.add_argument("--num-channels", type=int, default=3)
+    g.add_argument("--patch-dim", type=int, default=16)
+
+
+_GROUPS = [_network_size, _regularization, _training, _initialization,
+           _learning_rate, _checkpointing, _mixed_precision, _distributed,
+           _validation, _data, _autoresume, _biencoder, _vision, _logging]
+
+
+def parse_args(
+    extra_args_provider: Optional[Callable] = None,
+    defaults: Optional[Dict] = None,
+    ignore_unknown_args: bool = False,
+    args: Optional[Sequence[str]] = None,
+) -> argparse.Namespace:
+    """Parse the full Megatron-style flag surface and derive the consistency
+    fields the reference computes post-parse (reference parse_args).
+
+    ``defaults`` fills in values the command line left at None (reference
+    semantics: explicit command-line values win). A list as the first
+    positional is treated as argv (``parse_args(["--bf16"])``)."""
+    if isinstance(extra_args_provider, (list, tuple)):
+        args, extra_args_provider = extra_args_provider, None
+    p = argparse.ArgumentParser(
+        description="apex_tpu Megatron-style arguments", allow_abbrev=False)
+    for add in _GROUPS:
+        add(p)
+    if extra_args_provider is not None:
+        extra_args_provider(p)
+
+    if ignore_unknown_args:
+        ns, _ = p.parse_known_args(args)
+    else:
+        ns = p.parse_args(args)
+
+    for key, value in (defaults or {}).items():
+        if getattr(ns, key, None) is None:
+            setattr(ns, key, value)
+
+    return validate_args(ns)
+
+
+def validate_args(ns: argparse.Namespace) -> argparse.Namespace:
+    """The reference's post-parse derivations and checks."""
+    # deprecated flags error exactly like the reference
+    if ns.batch_size is not None:
+        raise ValueError("--batch-size is no longer valid, "
+                         "use --micro-batch-size instead")
+    del ns.batch_size
+    if ns.warmup is not None:
+        raise ValueError("--warmup is no longer valid, "
+                         "use --lr-warmup-fraction instead")
+    del ns.warmup
+    if ns.model_parallel_size is not None:
+        raise ValueError("--model-parallel-size is no longer valid, "
+                         "use --tensor-model-parallel-size instead")
+    del ns.model_parallel_size
+
+    ns.rank = int(os.getenv("RANK", "0"))
+    tp, pp = ns.tensor_model_parallel_size, ns.pipeline_model_parallel_size
+    # no launcher env: default the world to the requested model-parallel
+    # footprint (a single JAX process drives the whole mesh on TPU)
+    ns.world_size = int(os.getenv("WORLD_SIZE", "0")) or tp * pp
+    ns.tensor_model_parallel_size = tp = min(tp, ns.world_size)
+    if ns.world_size % tp:
+        raise ValueError(
+            f"world size ({ns.world_size}) is not divisible by tensor model "
+            f"parallel size ({tp})")
+    ns.pipeline_model_parallel_size = pp = min(pp, ns.world_size // tp)
+    if ns.world_size % (tp * pp):
+        raise ValueError(
+            f"world size ({ns.world_size}) is not divisible by "
+            f"tp ({tp}) x pp ({pp})")
+    ns.data_parallel_size = ns.world_size // (tp * pp)
+    if pp > 1 and ns.pipeline_model_parallel_split_rank is not None \
+            and ns.pipeline_model_parallel_split_rank >= pp:
+        raise ValueError(f"split rank must be less than pipeline size ({pp})")
+
+    # virtual pipeline sizing (reference: num-layers-per-virtual-pipeline-stage)
+    if ns.num_layers_per_virtual_pipeline_stage is not None:
+        per = ns.num_layers_per_virtual_pipeline_stage
+        if ns.num_layers is None or ns.num_layers % (pp * per):
+            raise ValueError(
+                "num-layers must divide by pipeline size x "
+                "num-layers-per-virtual-pipeline-stage")
+        ns.virtual_pipeline_model_parallel_size = ns.num_layers // pp // per
+    else:
+        ns.virtual_pipeline_model_parallel_size = None
+
+    # batch sizes
+    if ns.micro_batch_size is not None and ns.global_batch_size is None:
+        ns.global_batch_size = ns.micro_batch_size * ns.data_parallel_size
+    if ns.rampup_batch_size is not None:
+        # the in-repo consumer (microbatches.build_num_microbatches_calculator)
+        # unpacks (start, increment, samples) as ints
+        if len(ns.rampup_batch_size) != 3:
+            raise ValueError("--rampup-batch-size takes exactly 3 values: "
+                             "start increment samples")
+        ns.rampup_batch_size = [int(v) for v in ns.rampup_batch_size]
+
+    # precision: params dtype (reference: fp16->half, bf16->bfloat16)
     if ns.fp16 and ns.bf16:
         raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    import jax.numpy as jnp
+
+    ns.params_dtype = (jnp.float16 if ns.fp16
+                       else jnp.bfloat16 if ns.bf16 else jnp.float32)
+
+    # --checkpoint-activations rewrites to the uniform method (the
+    # reference's deprecation path); maps onto GPTConfig.remat here
+    if ns.checkpoint_activations:
+        ns.activations_checkpoint_method = "uniform"
+    ns.recompute_activations = ns.activations_checkpoint_method is not None
+
+    # vocab padding (the reference pads in the tokenizer build to a multiple
+    # of make-vocab-size-divisible-by x tp)
+    if ns.vocab_size is not None:
+        mult = ns.make_vocab_size_divisible_by * tp
+        ns.padded_vocab_size = -(-ns.vocab_size // mult) * mult
+    else:
+        ns.padded_vocab_size = None
+
+    # derived model dims (reference network-size derivations)
+    if ns.ffn_hidden_size is None and ns.hidden_size is not None:
+        ns.ffn_hidden_size = 4 * ns.hidden_size
+    if ns.kv_channels is None and ns.hidden_size is not None \
+            and ns.num_attention_heads:
+        ns.kv_channels = ns.hidden_size // ns.num_attention_heads
+    if ns.max_position_embeddings is None and ns.seq_length is not None:
+        ns.max_position_embeddings = ns.seq_length
     return ns

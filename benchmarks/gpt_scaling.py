@@ -150,9 +150,9 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             # only skipped rows fall back to the requested one
             res["config"].setdefault("layers", layers)
             eff = res["config"]["layers"]
-            if eff != layers:
-                res["config"]["requested_layers"] = layers
-            if any(r["config"] == res["config"] for r in rows):
+            base_cfg = {"dp": dp, "tp": tp, "pp": pp, "layers": eff}
+            if any({k: r["config"].get(k) for k in base_cfg} == base_cfg
+                   for r in rows):
                 # two requested counts rounded to the same effective config;
                 # don't record the same measurement twice under two labels
                 print(json.dumps({"config": {"dp": dp, "tp": tp, "pp": pp,
@@ -160,6 +160,8 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                                   "skipped": f"duplicate of layers={eff}"}),
                       flush=True)
                 continue
+            if eff != layers:
+                res["config"]["requested_layers"] = layers
             rows.append(res)
             print(json.dumps(res), flush=True)
             if output_dir:
