@@ -43,34 +43,54 @@ from apex_tpu.contrib.sparsity.permutation import (  # noqa: F401
 )
 
 
-def m4n2_mask_1d(w: jax.Array, axis: int = -2) -> jax.Array:
-    """2-of-4 magnitude mask along ``axis`` (sparse_masklib.py mn_1d_best for
-    m=4, n=2). The default ``axis=-2`` is the **contraction/input dim** of
-    this codebase's ``(in, out)`` kernels — the dim apex ASP prunes (torch
-    ``(out, in)`` weights masked along dim 1), which is what the sparse
-    tensor-core GEMM contracts over."""
+def __getattr__(name):
+    # ASP imports optax; load lazily so mask-only users skip that cost
+    if name == "ASP":
+        from apex_tpu.contrib.sparsity.asp import ASP
+
+        return ASP
+    raise AttributeError(name)
+
+
+def mn_mask_1d(w: jax.Array, m: int, n: int, axis: int = -2) -> jax.Array:
+    """n-of-m magnitude mask along ``axis`` (sparse_masklib.py mn_1d_best):
+    in every aligned group of ``m`` elements keep the ``n`` largest."""
     axis = axis % w.ndim
-    if w.shape[axis] % 4:
-        raise ValueError(f"dim {axis} of size {w.shape[axis]} not divisible by 4")
+    if w.shape[axis] % m:
+        raise ValueError(f"dim {axis} of size {w.shape[axis]} not divisible by {m}")
     wm = jnp.moveaxis(w, axis, -1)
-    groups = jnp.abs(wm).reshape(*wm.shape[:-1], -1, 4)
-    # rank within each group of 4; keep the top 2
+    groups = jnp.abs(wm).reshape(*wm.shape[:-1], -1, m)
+    # rank within each group of m; keep the top n
     order = jnp.argsort(groups, axis=-1)  # ascending
     ranks = jnp.argsort(order, axis=-1)
-    mask = (ranks >= 2).reshape(wm.shape)
+    mask = (ranks >= m - n).reshape(wm.shape)
     return jnp.moveaxis(mask, -1, axis)
 
 
-def _default_allow(path, leaf) -> bool:
-    """Prune 2-D+ weight leaves with input (contraction) dim divisible by 4
-    (the reference prunes Linear/Conv weights with shape constraints,
+def m4n2_mask_1d(w: jax.Array, axis: int = -2) -> jax.Array:
+    """2-of-4 magnitude mask along ``axis`` (sparse_masklib.py m4n2_1d).
+    The default ``axis=-2`` is the **contraction/input dim** of this
+    codebase's ``(in, out)`` kernels — the dim apex ASP prunes (torch
+    ``(out, in)`` weights masked along dim 1), which is what the sparse
+    tensor-core GEMM contracts over."""
+    return mn_mask_1d(w, 4, 2, axis=axis)
+
+
+def shape_eligible(leaf, m: int = 4) -> bool:
+    """Shape/dtype pruning eligibility: 2-D+ floating weight leaves whose
+    input (contraction) dim divides by the pattern's group size ``m`` (the
+    reference prunes Linear/Conv weights with shape constraints,
     asp.py:110-143)."""
     return (
         hasattr(leaf, "ndim")
         and leaf.ndim >= 2
-        and leaf.shape[-2] % 4 == 0
+        and leaf.shape[-2] % m == 0
         and jnp.issubdtype(leaf.dtype, jnp.floating)
     )
+
+
+def _default_allow(path, leaf) -> bool:
+    return shape_eligible(leaf)
 
 
 def compute_sparse_masks(

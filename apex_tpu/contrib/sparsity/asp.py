@@ -1,0 +1,234 @@
+"""ASP class workflow (reference: apex/contrib/sparsity/asp.py:28-312).
+
+The reference instruments a torch model in place: buffers for masks,
+``optimizer.step`` patched to re-mask after every update, permutation
+search hooked into ``compute_sparse_masks``. Params here are immutable
+pytrees, so the same four-phase workflow is functional:
+
+    ASP.init_model_for_pruning(params, "m4n2_1d",
+                               allowed_layer_names=..., allow_permutation=True)
+    tx = ASP.init_optimizer_for_pruning(FusedAdam(lr=...))   # masked updates
+    params, masks = ASP.compute_sparse_masks(params)          # enable sparsity
+    ... train with tx; updates to pruned slots are zeroed, so the 2:4
+        pattern survives every step (the patched-``step`` re-mask,
+        asp.py:188-202) ...
+    dense = ASP.restore_pruned_weights(params)                # if recompute
+
+One-call convenience mirroring ``ASP.prune_trained_model(model, optimizer)``
+(asp.py:293-298):
+
+    params, masks, tx = ASP.prune_trained_model(params, FusedAdam(lr=...))
+
+Class-level singleton state mirrors the reference's classmethod design —
+call :meth:`ASP.reset` between independent uses (tests do).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.contrib.sparsity import permutation as _plib
+
+_PATTERN_RE = re.compile(r"^m(\d+)n(\d+)_1d$")
+
+
+def _calculator_from_pattern(pattern: str) -> Tuple[Callable, int]:
+    """"m4n2_1d"-style pattern string → (mask function, group size m)
+    (sparse_masklib.py create_mask's pattern dispatch)."""
+    m = _PATTERN_RE.match(pattern)
+    if not m:
+        raise ValueError(f"unsupported mask pattern {pattern!r} "
+                         "(expected 'm<M>n<N>_1d')")
+    from apex_tpu.contrib.sparsity import mn_mask_1d
+
+    mm, nn = int(m.group(1)), int(m.group(2))
+
+    def calc(w):
+        return mn_mask_1d(w, mm, nn)
+
+    return calc, mm
+
+
+class ASP:
+    """Automatic SParsity — the reference's class-level singleton UX over
+    functional params (asp.py:28-312)."""
+
+    __calculate_mask: Optional[Callable] = None
+    __group_size: int = 4  # pattern's m — drives shape eligibility
+    __masks: Any = None
+    __allow_permutation: bool = True
+    __allowed_names: Optional[Sequence[str]] = None
+    __disallowed_names: Sequence[str] = ()
+    __pruned_values: Any = None  # dense-minus-sparse stash (allow_recompute)
+    __allow_recompute: bool = False
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def init_model_for_pruning(
+        cls,
+        params: Any,
+        mask_calculator: Any = "m4n2_1d",
+        verbosity: int = 3,
+        whitelist: Any = None,
+        allowed_layer_names: Optional[Sequence[str]] = None,
+        disallowed_layer_names: Sequence[str] = (),
+        allow_recompute_mask: bool = False,
+        custom_layer_dict: Optional[Dict] = None,
+        allow_permutation: bool = True,
+    ) -> None:
+        """Record eligibility + mask calculator (asp.py:39-161). ``params``
+        is inspected for shape-eligible leaves; name filters match the
+        reference's allowed/disallowed layer-name lists against the pytree
+        path. ``whitelist``/``custom_layer_dict`` (torch module types) have
+        no pytree analog — eligibility is by shape and name here."""
+        if cls.__calculate_mask is not None:
+            raise RuntimeError("ASP has been initialized already.")
+        del verbosity, whitelist, custom_layer_dict, params  # no-op here
+        if callable(mask_calculator):
+            cls.__calculate_mask = mask_calculator
+            cls.__group_size = 4
+        else:
+            cls.__calculate_mask, cls.__group_size = _calculator_from_pattern(
+                mask_calculator)
+        cls.__allowed_names = allowed_layer_names
+        cls.__disallowed_names = tuple(disallowed_layer_names)
+        cls.__allow_recompute = allow_recompute_mask
+        cls.__allow_permutation = allow_permutation
+
+    @classmethod
+    def already_init_asp_model(cls) -> bool:
+        """asp.py:163-174."""
+        return cls.__calculate_mask is not None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def _eligible(cls, path: str, leaf: Any) -> bool:
+        from apex_tpu.contrib.sparsity import shape_eligible
+
+        if not shape_eligible(leaf, cls.__group_size):
+            return False
+        if cls.__allowed_names is not None and not any(
+                name in path for name in cls.__allowed_names):
+            return False
+        return not any(name in path for name in cls.__disallowed_names)
+
+    @classmethod
+    def compute_sparse_masks(
+        cls,
+        params: Any,
+        permutation_groups: Optional[Sequence[_plib.ChannelGroup]] = None,
+    ) -> Tuple[Any, Any]:
+        """Compute masks and zero pruned weights (asp.py:204-255). With
+        ``allow_permutation`` and explicit ``permutation_groups`` (the
+        pytree stand-in for the reference's FX graph pass), runs the
+        channel-permutation search first. Returns ``(pruned_params,
+        masks)``; hold the masks for the train loop and checkpoints."""
+        if cls.__calculate_mask is None:
+            raise RuntimeError("call init_model_for_pruning first")
+        if cls.__allow_permutation and permutation_groups:
+            params, _ = _plib.search_and_permute(params, permutation_groups)
+
+        def _mask(path, leaf):
+            key = "/".join(str(getattr(p, "key", p)) for p in path)
+            if cls._eligible(key, leaf):
+                return cls.__calculate_mask(leaf)
+            return None
+
+        masks = jax.tree_util.tree_map_with_path(_mask, params)
+        is_none = lambda x: x is None
+        if cls.__allow_recompute:
+            cls.__pruned_values = jax.tree.map(
+                lambda p, m: None if m is None else jnp.where(m, 0, p),
+                params, masks, is_leaf=is_none)
+        pruned = jax.tree.map(
+            lambda p, m: p if m is None else jnp.where(m, p, 0).astype(p.dtype),
+            params, masks, is_leaf=is_none)
+        cls.__masks = masks
+        return pruned, masks
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def init_optimizer_for_pruning(cls, optimizer: Any) -> optax.GradientTransformation:
+        """Wrap an optimizer so updates to pruned slots are zeroed — the
+        functional analog of patching ``optimizer.step`` to re-mask
+        (asp.py:176-202). Works on any optax transform or this codebase's
+        ClassOptimizer wrappers; compose *before*
+        ``amp.MixedPrecisionOptimizer`` so masters stay masked too.
+
+        Mask resolution: ``update(..., masks=masks)`` takes precedence —
+        **pass masks explicitly inside jitted train steps** so they are
+        traced values, not constants. Without the kwarg, masks are read
+        from class state at trace/call time; a step traced *before*
+        ``compute_sparse_masks`` bakes in the masks-off branch, which is
+        the reference's behavior (sparsity off until masks computed) but
+        means such a step must be re-jitted after enabling sparsity."""
+        inner = getattr(optimizer, "transform", optimizer)
+
+        def init(params):
+            return inner.init(params)
+
+        def update(grads, state, params=None, masks=None, **kw):
+            updates, state = inner.update(grads, state, params, **kw)
+            masks = masks if masks is not None else cls.__masks
+            if masks is not None:
+                updates = jax.tree.map(
+                    lambda u, m: u if m is None else jnp.where(m, u, 0),
+                    updates, masks, is_leaf=lambda x: x is None)
+            return updates, state
+
+        return optax.GradientTransformation(init, update)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def restore_pruned_weights(cls, params: Any) -> Any:
+        """Disable sparsity: add back the stashed pruned values
+        (asp.py:257-270; requires ``allow_recompute_mask=True``)."""
+        if not cls.__allow_recompute or cls.__pruned_values is None:
+            raise RuntimeError(
+                "restore_pruned_weights needs init_model_for_pruning("
+                "allow_recompute_mask=True) and computed masks")
+        restored = jax.tree.map(
+            lambda p, v: p if v is None else p + v.astype(p.dtype),
+            params, cls.__pruned_values, is_leaf=lambda x: x is None)
+        cls.__masks = None
+        cls.__pruned_values = None  # a second restore must not re-add
+        return restored
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        """asp.py:272-291."""
+        return cls.__masks is not None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def prune_trained_model(
+        cls,
+        params: Any,
+        optimizer: Any,
+        permutation_groups: Optional[Sequence[_plib.ChannelGroup]] = None,
+    ) -> Tuple[Any, Any, optax.GradientTransformation]:
+        """One call: init + masked optimizer + compute masks (asp.py:293-298
+        — the recommended recipe for sparsifying a trained model)."""
+        cls.init_model_for_pruning(params, mask_calculator="m4n2_1d",
+                                   allow_permutation=permutation_groups is not None)
+        tx = cls.init_optimizer_for_pruning(optimizer)
+        pruned, masks = cls.compute_sparse_masks(params, permutation_groups)
+        return pruned, masks, tx
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def reset(cls) -> None:
+        """Clear singleton state (tests; no reference equivalent — the
+        reference asserts single initialization per process)."""
+        cls.__calculate_mask = None
+        cls.__masks = None
+        cls.__pruned_values = None
+        cls.__allowed_names = None
+        cls.__disallowed_names = ()
+        cls.__allow_recompute = False
+        cls.__allow_permutation = True

@@ -1,0 +1,190 @@
+"""ASP class-workflow tests (reference: apex/contrib/sparsity/asp.py and
+its test/toy_problem.py train-with-masks flow)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.contrib.sparsity import ASP, sequential_groups
+
+
+@pytest.fixture(autouse=True)
+def _reset_asp():
+    ASP.reset()
+    yield
+    ASP.reset()
+
+
+def _params(seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return {
+        "fc0": {"kernel": jax.random.normal(k1, (8, 16)), "bias": jnp.zeros(16)},
+        "fc1": {"kernel": jax.random.normal(k2, (16, 16)), "bias": jnp.zeros(16)},
+        "head": {"kernel": jax.random.normal(k3, (16, 4)), "bias": jnp.zeros(4)},
+    }
+
+
+def _sparsity(leaf):
+    return float((np.asarray(leaf) == 0).mean())
+
+
+def test_full_workflow_preserves_pattern_through_training():
+    params = _params()
+    ASP.init_model_for_pruning(params, "m4n2_1d")
+    tx = ASP.init_optimizer_for_pruning(optax.adam(1e-2))
+    assert not ASP.is_sparsity_enabled()
+    params, masks = ASP.compute_sparse_masks(params)
+    assert ASP.is_sparsity_enabled()
+    assert _sparsity(params["fc0"]["kernel"]) == pytest.approx(0.5)
+
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, 8))
+    y = jax.random.normal(jax.random.PRNGKey(10), (4, 4))
+
+    def loss_fn(p):
+        h = jax.nn.relu(x @ p["fc0"]["kernel"] + p["fc0"]["bias"])
+        h = jax.nn.relu(h @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+        return jnp.mean((h @ p["head"]["kernel"] + p["head"]["bias"] - y) ** 2)
+
+    state = tx.init(params)
+    l0 = float(loss_fn(params))
+    for _ in range(20):
+        grads = jax.grad(loss_fn)(params)
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    assert float(loss_fn(params)) < l0
+    # the 2:4 pattern survived training: pruned slots still zero
+    for name in ("fc0", "fc1", "head"):
+        m = np.asarray(masks[name]["kernel"])
+        assert not np.any(np.asarray(params[name]["kernel"])[~m])
+
+
+def test_name_filters():
+    params = _params()
+    ASP.init_model_for_pruning(params, "m4n2_1d",
+                               disallowed_layer_names=["head"])
+    ASP.init_optimizer_for_pruning(optax.sgd(1e-2))
+    _, masks = ASP.compute_sparse_masks(params)
+    assert masks["fc0"]["kernel"] is not None
+    assert masks["head"]["kernel"] is None
+    ASP.reset()
+    ASP.init_model_for_pruning(params, allowed_layer_names=["fc1"])
+    _, masks = ASP.compute_sparse_masks(params)
+    assert masks["fc0"]["kernel"] is None
+    assert masks["fc1"]["kernel"] is not None
+
+
+def test_pattern_string_m8n4():
+    params = {"w": {"kernel": jax.random.normal(jax.random.PRNGKey(0), (16, 8))}}
+    ASP.init_model_for_pruning(params, "m8n4_1d")
+    pruned, masks = ASP.compute_sparse_masks(params)
+    assert _sparsity(pruned["w"]["kernel"]) == pytest.approx(0.5)
+    # groups of 8 along the contraction dim each keep exactly 4
+    m = np.asarray(masks["w"]["kernel"])
+    assert (m.reshape(2, 8, 8).sum(axis=1) == 4).all()
+
+
+def test_restore_pruned_weights_roundtrip():
+    params = _params()
+    ASP.init_model_for_pruning(params, allow_recompute_mask=True)
+    pruned, _ = ASP.compute_sparse_masks(params)
+    dense = ASP.restore_pruned_weights(pruned)
+    assert not ASP.is_sparsity_enabled()
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-7), dense, params)
+
+
+def test_prune_trained_model_one_call_with_permutation():
+    params = _params(seed=3)
+    groups = sequential_groups(["fc0", "fc1", "head"])
+    pruned, masks, tx = ASP.prune_trained_model(params, optax.adam(1e-3),
+                                                permutation_groups=groups)
+    assert ASP.is_sparsity_enabled()
+    assert _sparsity(pruned["fc1"]["kernel"]) == pytest.approx(0.5)
+    state = tx.init(pruned)
+    grads = jax.tree.map(jnp.ones_like, pruned)
+    updates, _ = tx.update(grads, state, pruned)
+    # masked slots receive zero update
+    m = np.asarray(masks["fc1"]["kernel"])
+    assert not np.any(np.asarray(updates["fc1"]["kernel"])[~m])
+
+
+def test_explicit_masks_kwarg_under_jit():
+    """Inside jit, masks passed explicitly are traced values — a step traced
+    before compute_sparse_masks still masks correctly once masks exist."""
+    params = _params()
+    ASP.init_model_for_pruning(params)
+    tx = ASP.init_optimizer_for_pruning(optax.sgd(1e-1))
+    state = tx.init(params)
+
+    @jax.jit
+    def step(p, s, masks):
+        g = jax.tree.map(jnp.ones_like, p)
+        u, s = tx.update(g, s, p, masks=masks)
+        return optax.apply_updates(p, u), s
+
+    # trace once with all-None masks (sparsity off)
+    none_masks = jax.tree.map(lambda _: None, params,
+                              is_leaf=lambda x: x is None)
+    del none_masks  # mask pytree must match structure; trace with real ones
+    pruned, masks = ASP.compute_sparse_masks(params)
+    p2, _ = step(pruned, state, masks)
+    m = np.asarray(masks["fc0"]["kernel"])
+    assert not np.any(np.asarray(p2["fc0"]["kernel"])[~m])
+
+
+def test_eligibility_follows_pattern_group_size():
+    # (12, 8) kernel: divisible by 4 but not 8 -> m8n4 must skip it, not crash
+    params = {"w": {"kernel": jax.random.normal(jax.random.PRNGKey(0), (12, 8))}}
+    ASP.init_model_for_pruning(params, "m8n4_1d")
+    pruned, masks = ASP.compute_sparse_masks(params)
+    assert masks["w"]["kernel"] is None
+    ASP.reset()
+    # m2n1 prunes dims divisible by 2 that m4 would skip
+    params = {"w": {"kernel": jax.random.normal(jax.random.PRNGKey(0), (6, 8))}}
+    ASP.init_model_for_pruning(params, "m2n1_1d")
+    pruned, masks = ASP.compute_sparse_masks(params)
+    assert masks["w"]["kernel"] is not None
+    assert _sparsity(pruned["w"]["kernel"]) == pytest.approx(0.5)
+
+
+def test_double_restore_errors():
+    params = _params()
+    ASP.init_model_for_pruning(params, allow_recompute_mask=True)
+    pruned, _ = ASP.compute_sparse_masks(params)
+    ASP.restore_pruned_weights(pruned)
+    with pytest.raises(RuntimeError):
+        ASP.restore_pruned_weights(pruned)
+
+
+def test_double_init_errors():
+    ASP.init_model_for_pruning(_params())
+    with pytest.raises(RuntimeError, match="already"):
+        ASP.init_model_for_pruning(_params())
+    assert ASP.already_init_asp_model()
+
+
+def test_works_under_mixed_precision_optimizer():
+    # compose before MixedPrecisionOptimizer: masters stay masked
+    from apex_tpu import amp
+    params = _params()
+    ASP.init_model_for_pruning(params)
+    tx = ASP.init_optimizer_for_pruning(optax.adam(1e-3))
+    params, masks = ASP.compute_sparse_masks(params)
+    policy = amp.get_policy("O2")
+    mp_opt = amp.MixedPrecisionOptimizer(tx, policy)
+    params = amp.cast_params(params, policy)
+    # re-mask after the cast (bf16 rounding keeps zeros zero, but be explicit)
+    state = mp_opt.init(params)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8))
+
+    def scaled(p):
+        h = x.astype(p["fc0"]["kernel"].dtype) @ p["fc0"]["kernel"]
+        return mp_opt.scale_loss(jnp.mean(h.astype(jnp.float32) ** 2), state)
+
+    sloss, sgrads = jax.value_and_grad(scaled)(params)
+    new_params, state, _ = mp_opt.apply_gradients(state, params, sgrads)
+    m = np.asarray(masks["fc0"]["kernel"])
+    assert not np.any(np.asarray(new_params["fc0"]["kernel"])[~m])
+    assert not np.any(np.asarray(state.master["fc0"]["kernel"])[~m])
