@@ -47,6 +47,10 @@ def _calculator_from_pattern(pattern: str) -> Tuple[Callable, int]:
     from apex_tpu.contrib.sparsity import mn_mask_1d
 
     mm, nn = int(m.group(1)), int(m.group(2))
+    if not 0 < nn < mm:
+        raise ValueError(
+            f"pattern {pattern!r}: need 0 < n < m (n=m keeps everything, "
+            f"n=0 zeroes everything — neither is structured sparsity)")
 
     def calc(w):
         return mn_mask_1d(w, mm, nn)
@@ -112,10 +116,14 @@ class ASP:
 
         if not shape_eligible(leaf, cls.__group_size):
             return False
-        if cls.__allowed_names is not None and not any(
-                name in path for name in cls.__allowed_names):
+        # exact path-component membership, like the reference's exact
+        # layer-name check (asp.py allowed/disallowed lists) — substring
+        # matching would make "fc1" also cover "fc10"
+        segments = set(path.split("/"))
+        if cls.__allowed_names is not None and not segments.intersection(
+                cls.__allowed_names):
             return False
-        return not any(name in path for name in cls.__disallowed_names)
+        return not segments.intersection(cls.__disallowed_names)
 
     @classmethod
     def compute_sparse_masks(

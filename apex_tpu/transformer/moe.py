@@ -1,0 +1,252 @@
+"""Mixture-of-experts FFN with expert parallelism (NEW capability — the
+reference has none: SURVEY.md §2.3 lists expert parallel as absent).
+
+Design (TPU-first, the GShard/Switch dense-dispatch recipe):
+
+- **Routing**: softmax router over E experts, top-k gates, with the
+  Switch-style load-balancing auxiliary loss and router z-loss. All
+  routing math is dense einsums over one-hot dispatch/combine tensors —
+  no gather/scatter, so XLA tiles everything onto the MXU and shapes stay
+  static under jit.
+- **Capacity**: each expert processes at most C = ceil(top_k · N · cf / E)
+  tokens; over-capacity tokens fall through (their combine weight is 0),
+  the standard Switch behavior.
+- **Expert parallelism**: experts shard over a mesh axis. Inside
+  ``shard_map`` with tokens sharded on the *same* axis (the standard MoE
+  mapping: the data shards are the expert shards),
+  :meth:`MoEMLP.apply_expert_parallel` dispatches locally, exchanges
+  token buckets with one ``lax.all_to_all`` on the expert dim, runs the
+  local experts, and all_to_alls back — two collectives per layer, both
+  riding ICI. This is the NCCL all-to-all pattern of DeepSpeed-MoE /
+  Tutel expressed as a named-axis collective.
+
+Serial ``apply`` and sharded ``apply_expert_parallel`` compute the same
+function **when no tokens are dropped** (tests assert value and gradient
+equivalence at ample capacity). Under congestion they diverge by design:
+capacity is enforced per token shard in the parallel path (each shard caps
+its contribution to every expert at C_local), while the serial path caps
+globally — per-shard capacity is what keeps the all_to_all buckets static-
+shaped, and is the standard behavior of sharded MoE implementations.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import tensor_parallel as tp
+
+Params = Dict[str, Any]
+
+
+def _pmean_value_local_grad(v: jax.Array, axis: str) -> jax.Array:
+    """Cross-shard mean in the value, local-only gradient: returns
+    ``pmean(v)`` but backpropagates only ``v / axis_size`` — each shard's
+    cotangent covers exactly its local contribution to the mean, so
+    summing per-shard gradients (the normal replicated-param reduction)
+    yields the full-batch gradient regardless of how the collective's
+    transpose behaves under ``check_vma=False``."""
+    ep = lax.axis_size(axis)
+    bar = lax.pmean(lax.stop_gradient(v), axis)
+    return v / ep + (bar - lax.stop_gradient(v) / ep)
+
+
+class MoEMLP:
+    """Drop-in MoE replacement for the transformer FFN block.
+
+    Args:
+      hidden_size / ffn_hidden_size: per-expert FFN dims.
+      num_experts: E. Must divide by the expert-axis size when sharded.
+      top_k: experts per token (1 = Switch, 2 = GShard default).
+      capacity_factor: slack over the perfectly-balanced C.
+      expert_axis: mesh axis name the expert dim shards over (``specs``).
+      params_dtype: parameter dtype (router stays fp32 — routing logits
+        are precision-sensitive, like vocab logits).
+    """
+
+    def __init__(
+        self,
+        hidden_size: int,
+        ffn_hidden_size: int,
+        num_experts: int,
+        top_k: int = 2,
+        capacity_factor: float = 1.25,
+        expert_axis: Optional[str] = None,
+        params_dtype: Any = jnp.float32,
+        init_method=None,
+    ):
+        if top_k < 1 or top_k > num_experts:
+            raise ValueError(f"top_k ({top_k}) must be in [1, {num_experts}]")
+        self.hidden = hidden_size
+        self.ffn = ffn_hidden_size
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.expert_axis = expert_axis
+        self.params_dtype = params_dtype
+        self.init_method = init_method or tp.scaled_normal(0.02)
+
+    # -- parameters ---------------------------------------------------------
+
+    def init(self, key) -> Params:
+        kr, k1, k2 = jax.random.split(key, 3)
+        E, d, f = self.num_experts, self.hidden, self.ffn
+
+        def per_expert(k, shape):
+            return jax.vmap(lambda kk: self.init_method(kk, shape,
+                                                        self.params_dtype))(
+                jax.random.split(k, E))
+
+        return {
+            "router": {"kernel": self.init_method(kr, (d, E), jnp.float32)},
+            "fc1": {"kernel": per_expert(k1, (d, f)),
+                    "bias": jnp.zeros((E, f), self.params_dtype)},
+            "fc2": {"kernel": per_expert(k2, (f, d)),
+                    "bias": jnp.zeros((E, d), self.params_dtype)},
+        }
+
+    def specs(self) -> Params:
+        ax = self.expert_axis
+        return {
+            "router": {"kernel": P()},
+            "fc1": {"kernel": P(ax, None, None), "bias": P(ax, None)},
+            "fc2": {"kernel": P(ax, None, None), "bias": P(ax, None)},
+        }
+
+    # -- routing ------------------------------------------------------------
+
+    def _capacity(self, n_tokens: int) -> int:
+        return max(1, math.ceil(
+            self.top_k * n_tokens * self.capacity_factor / self.num_experts))
+
+    def _route(self, params: Params, h2d: jax.Array):
+        """(N, d) → dispatch (N, E, C) bool, combine (N, E, C) float,
+        aux losses. Dense one-hot formulation (GShard §3.2)."""
+        E, C = self.num_experts, self._capacity(h2d.shape[0])
+        logits = (h2d.astype(jnp.float32)
+                  @ params["router"]["kernel"].astype(jnp.float32))  # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k expert mask, built greedily so gate normalization matches
+        # the k=1 Switch and k=2 GShard formulations
+        gates = jnp.zeros_like(probs)
+        masked = probs
+        for _ in range(self.top_k):
+            idx = jnp.argmax(masked, axis=-1)
+            onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+            gates = gates + onehot * probs
+            masked = masked * (1.0 - onehot)
+        sel = gates > 0  # (N, E) — the chosen experts
+
+        # position of each token within its expert's buffer, in token order
+        pos = jnp.cumsum(sel.astype(jnp.int32), axis=0) - 1  # (N, E)
+        keep = sel & (pos < C)
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, C), C,
+                                dtype=probs.dtype)  # (N, E, C); C -> dropped
+        dispatch = pos_oh * keep[..., None]
+        # normalize gates over the k *selections* (GShard combine); a
+        # dropped expert's share is lost, NOT redistributed — renormalizing
+        # over kept gates would silently amplify the surviving expert's
+        # output ~2x under congestion
+        denom = jnp.sum(gates, axis=-1, keepdims=True)
+        combine = dispatch * (gates / jnp.maximum(denom, 1e-9))[..., None]
+
+        # per-batch routing statistics; the losses combine them in
+        # _aux_losses so the expert-parallel path can average stats across
+        # shards FIRST (E*sum(me*ce) is nonlinear — pmean of per-shard
+        # losses would be biased)
+        stats = {
+            "me": jnp.mean(probs, axis=0),  # mean router prob per expert
+            "ce": jnp.mean(sel.astype(jnp.float32), axis=0) / self.top_k,
+            "zsq": jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+        }
+        return dispatch, combine, stats
+
+    def _aux_losses(self, stats) -> Dict[str, jax.Array]:
+        """Switch load-balance loss E*sum(me*ce) + ST-MoE router z-loss."""
+        return {
+            "load_balancing_loss": self.num_experts * jnp.sum(
+                stats["me"] * stats["ce"]),
+            "router_z_loss": stats["zsq"],
+        }
+
+    # -- expert compute -----------------------------------------------------
+
+    def _experts(self, params: Params, x: jax.Array) -> jax.Array:
+        """(E_local, C', d) → (E_local, C', d): per-expert FFN, batched as
+        one einsum pair so all experts' GEMMs fuse into two MXU calls."""
+        dt = x.dtype
+        h = jnp.einsum("ecd,edf->ecf", x,
+                       params["fc1"]["kernel"].astype(dt))
+        h = jax.nn.gelu(h + params["fc1"]["bias"].astype(dt)[:, None, :])
+        out = jnp.einsum("ecf,efd->ecd", h,
+                         params["fc2"]["kernel"].astype(dt))
+        return out + params["fc2"]["bias"].astype(dt)[:, None, :]
+
+    # -- serial forward -----------------------------------------------------
+
+    def apply(self, params: Params, h: jax.Array) -> Tuple[jax.Array, Dict]:
+        """``(…, d) → (…, d)`` plus aux losses — all experts local."""
+        shape = h.shape
+        h2d = h.reshape(-1, shape[-1])
+        dispatch, combine, stats = self._route(params, h2d)
+        xs = jnp.einsum("nec,nd->ecd", dispatch.astype(h2d.dtype), h2d)
+        ys = self._experts(params, xs)
+        out = jnp.einsum("nec,ecd->nd", combine.astype(h2d.dtype), ys)
+        return out.reshape(shape), self._aux_losses(stats)
+
+    # -- expert-parallel forward --------------------------------------------
+
+    def apply_expert_parallel(self, params_local: Params,
+                              h_local: jax.Array) -> Tuple[jax.Array, Dict]:
+        """Run inside ``shard_map`` with tokens sharded over
+        ``expert_axis`` (dim 0 of the flattened tokens) and ``params``
+        sharded by :meth:`specs`. Each shard routes its local tokens to
+        **all** experts, all_to_alls the buckets so shard ``i`` receives
+        every shard's bucket for its local experts, runs them, and
+        all_to_alls back. Aux losses are means over the full batch.
+
+        Gradient convention (matches the rest of this codebase): every
+        per-shard gradient covers exactly that shard's local tokens —
+        expert-sharded params (fc1/fc2) are complete as-is; replicated
+        params (router) need the usual cross-shard psum
+        (``allreduce_gradients_by_spec``). Aggregate the training loss
+        with the identity-backward psum
+        (``reduce_from_tensor_model_parallel_region``), as
+        ``pipelined_loss_fn`` does — grad through a plain ``lax.psum``
+        over-counts by the axis size under ``check_vma=False``."""
+        ax = self.expert_axis
+        if ax is None:
+            raise ValueError("expert_axis is required for expert parallelism")
+        ep = lax.axis_size(ax)
+        E = self.num_experts
+        if E % ep:
+            raise ValueError(f"num_experts ({E}) must divide by the "
+                             f"{ax!r} axis size ({ep})")
+        shape = h_local.shape
+        h2d = h_local.reshape(-1, shape[-1])
+        # router params are replicated; local routing over local tokens
+        dispatch, combine, stats = self._route(params_local, h2d)
+        xs = jnp.einsum("nec,nd->ecd", dispatch.astype(h2d.dtype), h2d)
+        # exchange: split the expert dim across shards, collect every
+        # shard's bucket for our experts along the capacity dim
+        xs = lax.all_to_all(xs, ax, split_axis=0, concat_axis=1, tiled=True)
+        ys = self._experts(params_local, xs)  # (E/ep, ep*C, d)
+        ys = lax.all_to_all(ys, ax, split_axis=1, concat_axis=0, tiled=True)
+        out = jnp.einsum("nec,ecd->nd", combine.astype(h2d.dtype), ys)
+        # average the raw statistics across shards BEFORE combining — the
+        # load-balance loss is bilinear in (me, ce), so averaging finished
+        # per-shard losses would not equal the full-batch loss. The
+        # collective itself sits under stop_gradient with the gradient
+        # routed through the local term (value identical): under
+        # shard_map(check_vma=False) the transpose of pmean over-counts by
+        # the axis size, and each shard should own exactly its local
+        # tokens' router gradient anyway (the caller psums router grads
+        # like any replicated-param gradient).
+        stats = {k: _pmean_value_local_grad(v, ax) for k, v in stats.items()}
+        return out.reshape(shape), self._aux_losses(stats)

@@ -111,27 +111,36 @@ def test_prune_trained_model_one_call_with_permutation():
 
 
 def test_explicit_masks_kwarg_under_jit():
-    """Inside jit, masks passed explicitly are traced values — a step traced
-    before compute_sparse_masks still masks correctly once masks exist."""
+    """Masks passed explicitly are traced values: a step compiled once with
+    all-ones masks (sparsity off) masks correctly when later called with
+    real masks — no retrace, no baked-in constants."""
     params = _params()
     ASP.init_model_for_pruning(params)
     tx = ASP.init_optimizer_for_pruning(optax.sgd(1e-1))
     state = tx.init(params)
 
+    traces = 0
+
     @jax.jit
     def step(p, s, masks):
+        nonlocal traces
+        traces += 1
         g = jax.tree.map(jnp.ones_like, p)
         u, s = tx.update(g, s, p, masks=masks)
         return optax.apply_updates(p, u), s
 
-    # trace once with all-None masks (sparsity off)
-    none_masks = jax.tree.map(lambda _: None, params,
-                              is_leaf=lambda x: x is None)
-    del none_masks  # mask pytree must match structure; trace with real ones
     pruned, masks = ASP.compute_sparse_masks(params)
-    p2, _ = step(pruned, state, masks)
+    ones_masks = jax.tree.map(
+        lambda m: None if m is None else jnp.ones_like(m),
+        masks, is_leaf=lambda x: x is None)
+    # trace with sparsity effectively off
+    p1, _ = step(pruned, state, ones_masks)
     m = np.asarray(masks["fc0"]["kernel"])
+    assert np.any(np.asarray(p1["fc0"]["kernel"])[~m])  # updates flowed
+    # same compiled fn, real masks: pruned slots frozen
+    p2, _ = step(pruned, state, masks)
     assert not np.any(np.asarray(p2["fc0"]["kernel"])[~m])
+    assert traces == 1, "mask values must be traced, not trigger retrace"
 
 
 def test_eligibility_follows_pattern_group_size():
@@ -147,6 +156,24 @@ def test_eligibility_follows_pattern_group_size():
     pruned, masks = ASP.compute_sparse_masks(params)
     assert masks["w"]["kernel"] is not None
     assert _sparsity(pruned["w"]["kernel"]) == pytest.approx(0.5)
+
+
+def test_degenerate_patterns_rejected():
+    for bad in ("m4n6_1d", "m4n4_1d", "m4n0_1d"):
+        ASP.reset()
+        with pytest.raises(ValueError, match="0 < n < m"):
+            ASP.init_model_for_pruning(_params(), bad)
+
+
+def test_name_filters_match_path_components_exactly():
+    params = {
+        "fc1": {"kernel": jax.random.normal(jax.random.PRNGKey(0), (8, 8))},
+        "fc10": {"kernel": jax.random.normal(jax.random.PRNGKey(1), (8, 8))},
+    }
+    ASP.init_model_for_pruning(params, disallowed_layer_names=["fc1"])
+    _, masks = ASP.compute_sparse_masks(params)
+    assert masks["fc1"]["kernel"] is None      # excluded
+    assert masks["fc10"]["kernel"] is not None  # NOT a substring match
 
 
 def test_double_restore_errors():
