@@ -187,28 +187,60 @@ class TransformerBase:
     def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
         raise NotImplementedError
 
+    # -- per-layer aux hooks (override point for layers that emit side
+    # losses, e.g. MoE routers) ---------------------------------------------
+
+    def _aux_init(self):
+        """Zero-valued aux accumulator pytree, or None when layers emit no
+        aux (the default)."""
+        return None
+
+    def _layer_aux(self, p: Params, h: jax.Array, key, bias):
+        """``(h, aux)`` for one layer; default layers emit no aux."""
+        return self._layer(p, h, key, bias), None
+
     def run_layers(
         self,
         layers: Params,
         h: jax.Array,
         attn_bias: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
-    ) -> jax.Array:
+        return_aux: bool = False,
+    ):
         """Scan the (stacked) layer params over the hidden state. ``layers``
         may be any contiguous slice of the stack — a pipeline stage's chunk.
         Activation checkpointing is ``jax.checkpoint`` on the scanned body
-        (reference: tensor_parallel/random.py:224-294 CheckpointFunction)."""
+        (reference: tensor_parallel/random.py:224-294 CheckpointFunction).
+
+        When the model's layers emit aux losses (``_aux_init`` not None),
+        they accumulate in the scan carry and the caller MUST pass
+        ``return_aux=True`` — silently discarding router losses would turn
+        the MoE balancing knobs into no-ops."""
         n = jax.tree.leaves(layers)[0].shape[0]
         keys = None if dropout_key is None else jax.random.split(dropout_key, n)
+        aux0 = self._aux_init()
+        if aux0 is not None and not return_aux:
+            raise ValueError(
+                "this model's layers emit aux losses (MoE router); call "
+                "run_layers(..., return_aux=True) and fold them into the "
+                "loss — dropping them silently disables load balancing. "
+                "(Pipeline schedules do not support aux-emitting layers yet.)"
+            )
 
-        def body(h, xs):
+        def body(carry, xs):
+            h, acc = carry
             p, k = xs
-            return self._layer(p, h, k, attn_bias), None
+            h, aux = self._layer_aux(p, h, k, attn_bias)
+            if acc is not None:
+                acc = jax.tree.map(
+                    jnp.add, acc,
+                    jax.tree.map(lambda v: v.astype(jnp.float32), aux))
+            return (h, acc), None
 
         if self.cfg.remat:
             body = jax.checkpoint(
                 body, prevent_cse=False,
                 policy=_remat_policy(getattr(self.cfg, "remat_policy", None)),
             )
-        h, _ = lax.scan(body, h, (layers, keys))
-        return h
+        (h, aux), _ = lax.scan(body, (h, aux0), (layers, keys))
+        return (h, aux) if return_aux else h

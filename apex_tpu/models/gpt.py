@@ -74,7 +74,18 @@ class GPTConfig:
     # attend with ring attention (ppermute block exchange) or Ulysses
     # all-to-all. Run under shard_map with tokens sharded on dim 1.
     context_axis: Optional[str] = None
-    sequence_parallel_impl: str = "ring"  # 'ring' | 'ulysses' 
+    sequence_parallel_impl: str = "ring"  # 'ring' | 'ulysses'
+    # mixture-of-experts FFN (NEW vs the reference, SURVEY.md §2.3 row EP):
+    # when moe_num_experts is set, every layer's dense FFN becomes a top-k
+    # routed MoEMLP (transformer/moe.py). moe_expert_axis shards experts
+    # over that mesh axis with all_to_all dispatch — run under shard_map
+    # with the batch dim sharded over the same axis (the data axis).
+    moe_num_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_expert_axis: Optional[str] = None
+    moe_aux_loss_weight: float = 0.01
+    moe_z_loss_weight: float = 1e-3
 
     @property
     def ffn(self) -> int:
@@ -99,7 +110,50 @@ class GPTModel(TransformerBase):
 
     causal = True
 
+    def __init__(self, config):
+        super().__init__(config)
+        c = config
+        if c.moe_num_experts is not None:
+            from apex_tpu.transformer.moe import MoEMLP
+
+            self.moe = MoEMLP(
+                c.hidden_size, c.ffn, num_experts=c.moe_num_experts,
+                top_k=c.moe_top_k, capacity_factor=c.moe_capacity_factor,
+                expert_axis=c.moe_expert_axis,
+                params_dtype=c.params_dtype,
+                init_method=tp.scaled_normal(c.init_method_std),
+            )
+
     # -- parameters ---------------------------------------------------------
+
+    def _layer_init(self, k: jax.Array) -> Params:
+        if self.cfg.moe_num_experts is None:
+            return super()._layer_init(k)
+        # build only what the MoE block uses — initializing the dense
+        # fc1/fc2 just to discard them would materialize the full FFN
+        # weights once per layer under the vmapped stack init
+        ks = jax.random.split(k, 3)
+        return {
+            "ln1": self._ln_init(),
+            "qkv": self.qkv.init(ks[0]),
+            "proj": self.proj.init(ks[1]),
+            "ln2": self._ln_init(),
+            "moe": self.moe.init(ks[2]),
+        }
+
+    def layer_stack_specs(self) -> Params:
+        if self.cfg.moe_num_experts is None:
+            return super().layer_stack_specs()
+        from apex_tpu.models._transformer import stack_specs
+
+        ln = {"scale": P(), "bias": P()}
+        return {
+            "ln1": stack_specs(ln),
+            "qkv": stack_specs(self.qkv.specs()),
+            "proj": stack_specs(self.proj.specs()),
+            "ln2": stack_specs(ln),
+            "moe": stack_specs(self.moe.specs()),
+        }
 
     def init(self, key: jax.Array) -> Params:
         c = self.cfg
@@ -166,6 +220,27 @@ class GPTModel(TransformerBase):
         h = h + self._dropout(self._mlp(p, self._ln(p["ln2"], h)), k2)
         return h
 
+    def _aux_init(self):
+        if self.cfg.moe_num_experts is None:
+            return None
+        return {"load_balancing_loss": jnp.zeros(()),
+                "router_z_loss": jnp.zeros(())}
+
+    def _layer_aux(self, p: Params, h: jax.Array, key, bias):
+        """MoE layers emit the router aux losses; dense layers defer to the
+        base hook (accumulation lives in TransformerBase.run_layers)."""
+        c = self.cfg
+        if c.moe_num_experts is None:
+            return super()._layer_aux(p, h, key, bias)
+        k1, k2 = (None, None) if key is None else tuple(jax.random.split(key))
+        h = h + self._dropout(self._attention(p, self._ln(p["ln1"], h), bias), k1)
+        x = self._ln(p["ln2"], h)
+        if c.moe_expert_axis is not None:
+            out, aux = self.moe.apply_expert_parallel(p["moe"], x)
+        else:
+            out, aux = self.moe.apply(p["moe"], x)
+        return h + self._dropout(out, k2), aux
+
     def head(
         self, params: Params, h: jax.Array,
         targets: Optional[jax.Array] = None,
@@ -194,9 +269,19 @@ class GPTModel(TransformerBase):
         targets: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
     ):
+        c = self.cfg
         h = self.embed(params, tokens)
-        h = self.run_layers(params["layers"], h, dropout_key=dropout_key)
-        return self.head(params, h, targets)
+        h, aux = self.run_layers(params["layers"], h, dropout_key=dropout_key,
+                                 return_aux=True)
+        out = self.head(params, h, targets)
+        if aux is not None and targets is not None:
+            # fold per-layer-averaged router losses into the per-token loss
+            # (a scalar added uniformly keeps the mean-loss contract)
+            out = out + (
+                c.moe_aux_loss_weight * aux["load_balancing_loss"]
+                + c.moe_z_loss_weight * aux["router_z_loss"]
+            ).astype(out.dtype) / c.num_layers
+        return out
 
     def loss(self, params, tokens, targets, dropout_key=None) -> jax.Array:
         """Mean per-token loss — the fwd_step_func contract

@@ -71,26 +71,38 @@ def test_capacity_drops_overflow_tokens():
 
 
 def test_dropped_expert_share_is_lost_not_redistributed():
-    """GShard combine: when a token's top-1 expert is over capacity, the
-    surviving expert keeps weight g2/(g1+g2) — the dropped share is not
-    renormalized onto it."""
-    layer = MoEMLP(hidden_size=8, ffn_hidden_size=16, num_experts=2,
-                   top_k=2, capacity_factor=0.51)
+    """GShard combine: when a token's top-1 expert is over capacity but its
+    top-2 expert still has room, the survivor keeps weight g2/(g1+g2) —
+    the dropped share is NOT renormalized onto it."""
+    layer = MoEMLP(hidden_size=8, ffn_hidden_size=16, num_experts=4,
+                   top_k=2, capacity_factor=1.0)
     params = layer.init(jax.random.PRNGKey(0))
-    x = jnp.tile(jax.random.normal(jax.random.PRNGKey(4), (1, 8)), (8, 1))
+    # router reads features directly: e0 strong for everyone; e1/e2 are the
+    # second choices of token types a/b respectively
+    kernel = np.zeros((8, 4), np.float32)
+    kernel[0, 0], kernel[1, 1], kernel[2, 2] = 4.0, 2.0, 2.0
+    params["router"]["kernel"] = jnp.asarray(kernel)
+    tok_a = np.zeros(8, np.float32); tok_a[0] = tok_a[1] = 1.0  # (e0, e1)
+    tok_b = np.zeros(8, np.float32); tok_b[0] = tok_b[2] = 1.0  # (e0, e2)
+    tok_a[3:] = 0.3; tok_b[3:] = -0.3  # nonzero payload features
+    x = jnp.asarray(np.stack([tok_a] * 5 + [tok_b] * 3))
+
     out, _ = layer.apply(params, x)
-    logits = np.asarray(x[0]) @ np.asarray(params["router"]["kernel"])
-    probs = np.exp(logits - logits.max()); probs /= probs.sum()
-    e1, e2 = int(np.argmax(probs)), int(np.argmin(probs))
-    g = probs / probs.sum()
-    full = g[e1] * _expert_ffn(params, e1, np.asarray(x[0])) + \
-           g[e2] * _expert_ffn(params, e2, np.asarray(x[0]))
-    # capacity ceil(2*8*0.51/2)=5 < 8: later tokens lose experts; a token
-    # served by only e2 must produce g2-weighted output, not full weight
-    partial = g[e2] * _expert_ffn(params, e2, np.asarray(x[0]))
-    for row in np.asarray(out[5:]):  # beyond e1's capacity
-        assert np.allclose(row, partial, atol=1e-5) or np.allclose(
-            row, 0, atol=1e-6), "dropped share must not be redistributed"
+    # capacity = ceil(2*8*1.0/4) = 4: e0 serves tokens 0-3 and drops 4-7;
+    # e2 (3 b-tokens) is under capacity, so tokens 5-7 keep ONLY e2
+    probs_b = np.asarray(jax.nn.softmax(jnp.asarray(tok_b @ kernel)))
+    g0, g2 = probs_b[0], probs_b[2]
+    w = g2 / (g0 + g2)
+    partial = w * _expert_ffn(params, 2, tok_b)
+    inflated = 1.0 * _expert_ffn(params, 2, tok_b)  # the renormalized bug
+    for i in (5, 6, 7):
+        np.testing.assert_allclose(np.asarray(out[i]), partial, atol=1e-5)
+        assert not np.allclose(np.asarray(out[i]), inflated, atol=1e-3)
+    # token 0 keeps both experts at full gate weights
+    probs_a = np.asarray(jax.nn.softmax(jnp.asarray(tok_a @ kernel)))
+    ga0, ga1 = probs_a[0], probs_a[1]
+    full = (ga0 * _expert_ffn(params, 0, tok_a)
+            + ga1 * _expert_ffn(params, 1, tok_a)) / (ga0 + ga1)
     np.testing.assert_allclose(np.asarray(out[0]), full, atol=1e-5)
 
 
