@@ -213,12 +213,7 @@ class GPTModel(TransformerBase):
 
     def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
         """Pre-LN block: residual + sublayer(LN(h))."""
-        k1, k2 = (None, None) if key is None else tuple(jax.random.split(key))
-        # Post-residual dropout is replicated across TP ranks (same key);
-        # the reference draws it from the default (data-parallel) RNG state.
-        h = h + self._dropout(self._attention(p, self._ln(p["ln1"], h), bias), k1)
-        h = h + self._dropout(self._mlp(p, self._ln(p["ln2"], h)), k2)
-        return h
+        return self._layer_aux(p, h, key, bias)[0]
 
     def _aux_init(self):
         if self.cfg.moe_num_experts is None:
@@ -227,15 +222,17 @@ class GPTModel(TransformerBase):
                 "router_z_loss": jnp.zeros(())}
 
     def _layer_aux(self, p: Params, h: jax.Array, key, bias):
-        """MoE layers emit the router aux losses; dense layers defer to the
-        base hook (accumulation lives in TransformerBase.run_layers)."""
+        """One pre-LN block body for both FFN variants: dense MLP (aux is
+        None) or routed experts (aux = router losses)."""
         c = self.cfg
-        if c.moe_num_experts is None:
-            return super()._layer_aux(p, h, key, bias)
         k1, k2 = (None, None) if key is None else tuple(jax.random.split(key))
+        # Post-residual dropout is replicated across TP ranks (same key);
+        # the reference draws it from the default (data-parallel) RNG state.
         h = h + self._dropout(self._attention(p, self._ln(p["ln1"], h), bias), k1)
         x = self._ln(p["ln2"], h)
-        if c.moe_expert_axis is not None:
+        if c.moe_num_experts is None:
+            out, aux = self._mlp(p, x), None
+        elif c.moe_expert_axis is not None:
             out, aux = self.moe.apply_expert_parallel(p["moe"], x)
         else:
             out, aux = self.moe.apply(p["moe"], x)

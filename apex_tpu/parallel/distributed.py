@@ -79,23 +79,40 @@ def allreduce_gradients_by_spec(
 ) -> Any:
     """Spec-aware gradient reduction for hybrid-parallel training.
 
-    Every grad averages over the data axes; grads of params *replicated*
-    over an axis in ``replicated_axes`` (the axis does not appear in their
-    PartitionSpec) are additionally **summed** over it. Under the SPMD
-    pipeline this is exactly the reference's embedding-group allreduce for
-    tied embeddings (parallel_state.py:165-184): stage-masked contributions
-    (input embedding on the first stage, LM head on the last) sum to the
-    total tied gradient.
+    Grads average over the data axes their param is *replicated* on — an
+    axis appearing in the param's PartitionSpec means each shard holds a
+    **different** slice (e.g. MoE experts sharded over ``data``), whose
+    gradient is already complete locally and must NOT be mixed across
+    shards. Grads of params replicated over an axis in ``replicated_axes``
+    (the axis does not appear in their PartitionSpec) are additionally
+    **summed** over it. Under the SPMD pipeline this is exactly the
+    reference's embedding-group allreduce for tied embeddings
+    (parallel_state.py:165-184): stage-masked contributions (input
+    embedding on the first stage, LM head on the last) sum to the total
+    tied gradient.
     """
     data_axes = (data_axes,) if isinstance(data_axes, str) else tuple(data_axes)
 
     def _reduce(g, spec):
-        g = allreduce_gradients(g, data_axes, **opts)
         spec_axes = set()
         for entry in spec:
             if entry is None:
                 continue
             spec_axes.update((entry,) if isinstance(entry, str) else entry)
+        reduce_axes = tuple(a for a in data_axes if a not in spec_axes)
+        if reduce_axes:
+            g = allreduce_gradients(g, reduce_axes, **opts)
+        skipped = tuple(a for a in data_axes if a in spec_axes)
+        if skipped and opts.get("gradient_average", True):
+            # the loss is a mean of per-shard local means; a data-sharded
+            # param's AD gradient sums every shard's cotangent (e.g. MoE
+            # expert weights receive tokens from all shards via the
+            # all_to_all transpose), so the 1/axis-size averaging factor
+            # still applies even though no psum happens
+            denom = 1
+            for a in skipped:
+                denom *= lax.axis_size(a)
+            g = g / denom
         extra = tuple(a for a in replicated_axes if a not in spec_axes)
         if extra:
             g = lax.psum(g, extra)

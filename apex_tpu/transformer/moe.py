@@ -46,14 +46,15 @@ Params = Dict[str, Any]
 
 def _pmean_value_local_grad(v: jax.Array, axis: str) -> jax.Array:
     """Cross-shard mean in the value, local-only gradient: returns
-    ``pmean(v)`` but backpropagates only ``v / axis_size`` — each shard's
-    cotangent covers exactly its local contribution to the mean, so
-    summing per-shard gradients (the normal replicated-param reduction)
-    yields the full-batch gradient regardless of how the collective's
-    transpose behaves under ``check_vma=False``."""
-    ep = lax.axis_size(axis)
+    ``pmean(v)`` but backpropagates the identity onto the local ``v`` —
+    each shard's gradient covers its local tokens at full scale, exactly
+    like the local-mean CE loss's gradient, so the standard data-parallel
+    reduction (``allreduce_gradients_by_spec``: pmean replicated-param
+    grads) recovers the full-batch gradient. Keeps the collective itself
+    out of the backward graph (its transpose over-counts under
+    ``check_vma=False``)."""
     bar = lax.pmean(lax.stop_gradient(v), axis)
-    return v / ep + (bar - lax.stop_gradient(v) / ep)
+    return v + (bar - lax.stop_gradient(v))
 
 
 class MoEMLP:
@@ -211,15 +212,17 @@ class MoEMLP:
         every shard's bucket for its local experts, runs them, and
         all_to_alls back. Aux losses are means over the full batch.
 
-        Gradient convention (matches the rest of this codebase): every
-        per-shard gradient covers exactly that shard's local tokens —
-        expert-sharded params (fc1/fc2) are complete as-is; replicated
-        params (router) need the usual cross-shard psum
-        (``allreduce_gradients_by_spec``). Aggregate the training loss
-        with the identity-backward psum
-        (``reduce_from_tensor_model_parallel_region``), as
-        ``pipelined_loss_fn`` does — grad through a plain ``lax.psum``
-        over-counts by the axis size under ``check_vma=False``."""
+        Gradient convention — the standard data-parallel recipe of this
+        codebase: compute the **local-mean** loss per shard (aux losses
+        included; their stats helper backpropagates at local scale to
+        match) and reduce gradients with ``allreduce_gradients_by_spec``:
+        replicated params (router, attention, …) pmean over the data
+        axes, while expert-sharded params skip the psum but still apply
+        the 1/axis-size averaging factor (their AD gradient already sums
+        all shards' cotangents through the all_to_all transpose). Do not
+        differentiate through a hand-written ``lax.psum`` of the loss —
+        its transpose over-counts by the axis size under
+        ``check_vma=False``."""
         ax = self.expert_axis
         if ax is None:
             raise ValueError("expert_axis is required for expert parallelism")

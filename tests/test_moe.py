@@ -169,25 +169,19 @@ def test_expert_parallel_gradients_match_serial(mesh4):
     specs = layer.specs()
 
     def ep_loss(p, xl):
-        # repo convention (pipelined_loss_fn): aggregate the loss with the
-        # identity-backward psum so each shard's cotangent covers exactly
-        # its local tokens — grad-through-plain-psum over-counts by the
-        # axis size under check_vma=False
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            reduce_from_tensor_model_parallel_region as psum_id_bwd)
-
+        # the documented convention: local-mean loss per shard (aux
+        # included), spec-aware gradient reduction afterwards
         out, aux = layer.apply_expert_parallel(p, xl)
-        total = psum_id_bwd(jnp.sum(out ** 2), "expert") / x.size
-        return total + 0.01 * aux["load_balancing_loss"]
+        return jnp.mean(out ** 2) + 0.01 * aux["load_balancing_loss"]
 
     def grads(p, xl):
+        from apex_tpu.parallel.distributed import allreduce_gradients_by_spec
+
         g = jax.grad(ep_loss)(p, xl)
-        # expert-sharded grads stay local; replicated router grad sums
-        return {
-            "router": jax.tree.map(lambda a: jax.lax.psum(a, "expert"),
-                                   g["router"]),
-            "fc1": g["fc1"], "fc2": g["fc2"],
-        }
+        # replicated router pmeans; expert-sharded fc grads skip the psum
+        # but keep the 1/ep averaging factor
+        return allreduce_gradients_by_spec(
+            g, specs, data_axes=("expert",), replicated_axes=())
 
     sharded = jax.device_put(
         params, jax.tree.map(lambda s: NamedSharding(mesh4, s), specs,
