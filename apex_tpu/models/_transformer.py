@@ -224,7 +224,8 @@ class TransformerBase:
                 "this model's layers emit aux losses (MoE router); call "
                 "run_layers(..., return_aux=True) and fold them into the "
                 "loss — dropping them silently disables load balancing. "
-                "(Pipeline schedules do not support aux-emitting layers yet.)"
+                "Under the pipeline schedules, pass run_layers with "
+                "return_aux=True plus aux_to_loss to pipelined_loss_fn."
             )
 
         def body(carry, xs):

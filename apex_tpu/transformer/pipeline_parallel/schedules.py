@@ -159,8 +159,24 @@ def _pipeline_ring(
     buf0 = jnp.zeros(mb_shape, h_microbatches.dtype)
     perm = [(i, (i + 1) % S) for i in range(S)]
 
+    # probe whether run_stage emits per-chunk aux losses (MoE routers):
+    # (h, aux_tree) return → accumulate aux over live ticks
+    probe = jax.eval_shape(
+        run_stage,
+        jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct((per,) + x.shape[1:], x.dtype),
+            layers_local,
+        ),
+        jax.ShapeDtypeStruct(mb_shape, h_microbatches.dtype),
+    )
+    with_aux = isinstance(probe, tuple)
+    aux0 = (
+        jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), probe[1])
+        if with_aux else None
+    )
+
     def tick(carry, t):
-        buf, out = carry
+        buf, out, aux_acc = carry
         k_raw = t - s_idx
         k = jnp.clip(k_raw, 0, n_units - 1)
         j = k % S
@@ -177,18 +193,28 @@ def _pipeline_ring(
                 lambda x: lax.dynamic_slice_in_dim(x, q * per, per, axis=0),
                 layers_local,
             )
-        h_out = run_stage(chunk, h_in)
         live = (k_raw >= 0) & (k_raw < n_units)
+        if with_aux:
+            h_out, aux = run_stage(chunk, h_in)
+            # fill/drain ticks process garbage activations; only live
+            # ticks are real (microbatch, chunk) units, each processed
+            # exactly once across the ring — masked sum = full-batch aux
+            aux_acc = jax.tree.map(
+                lambda a, v: a + jnp.where(live, v.astype(jnp.float32), 0.0),
+                aux_acc, aux)
+        else:
+            h_out = run_stage(chunk, h_in)
         finished = (s_idx == S - 1) & (q == vpp - 1) & live
         cur = lax.dynamic_index_in_dim(out, m, 0, keepdims=False)
         out = lax.dynamic_update_index_in_dim(
             out, jnp.where(finished, h_out, cur), m, 0
         )
         buf = lax.ppermute(h_out, axis, perm)
-        return (buf, out), None
+        return (buf, out, aux_acc), None
 
-    (_, out), _ = lax.scan(tick, (buf0, out0), jnp.arange(n_ticks))
-    return out
+    (_, out, aux_sum), _ = lax.scan(
+        tick, (buf0, out0, aux0), jnp.arange(n_ticks))
+    return (out, aux_sum) if with_aux else out
 
 
 def pipelined_loss_fn(
@@ -200,14 +226,35 @@ def pipelined_loss_fn(
     axis: str = AXIS_PIPE,
     virtual_pipeline_size: int = 1,
     shard_head: bool = True,
+    aux_to_loss: Optional[Callable[[Any], jax.Array]] = None,
 ) -> Callable:
     """Build ``loss(params, layers_local, batch, targets) -> scalar`` running
     the layer stack through the SPMD pipeline.
 
     Args:
       embed: ``(params, batch) -> (B, ...) activations`` (replicated work).
-      run_layers: ``(layer_chunk_params, h) -> h`` applying a stage chunk.
+      run_layers: ``(layer_chunk_params, h) -> h`` applying a stage chunk —
+        or ``-> (h, aux_tree)`` for layers that emit side losses (MoE
+        routers: pass ``lambda lp, h: model.run_layers(lp, h,
+        return_aux=True)``). Aux trees accumulate over every live
+        (microbatch, chunk) unit across stages; the per-microbatch mean
+        goes through ``aux_to_loss``.
       head_loss: ``(params, h, targets) -> per-element loss``.
+      aux_to_loss: maps the accumulated aux tree to a scalar added to the
+        loss. **Must be linear** (a weighted sum): it is applied to each
+        stage's local accumulator and the results sum across stages via
+        the identity-backward psum. Required when run_layers emits aux;
+        silently dropping router losses would disable load balancing.
+
+        Aux semantics: each (microbatch, chunk) unit contributes the aux
+        its layers computed **on that microbatch**, and the total is
+        averaged over microbatches — i.e. the mean over microbatches of
+        per-microbatch aux losses, which is how microbatched/
+        gradient-accumulating training (and Megatron-style MoE) computes
+        router losses. This differs from a single full-batch forward by
+        the bilinearity of the load-balance loss (an O(variance/M) gap);
+        the exact reference is the serial model run per microbatch with
+        losses averaged (tests pin this).
       num_microbatches: M; the batch dim must divide by it.
       axis: pipeline mesh axis (bound inside shard_map).
       virtual_pipeline_size: interleaved chunks per stage; layer stacks must
@@ -233,7 +280,21 @@ def pipelined_loss_fn(
             raise ValueError(f"batch ({bsz}) must divide by microbatches ({M})")
         h_mb = h.reshape((M, bsz // M) + h.shape[1:])
 
-        out = _pipeline_ring(run_layers, layers_local, h_mb, axis, vpp=vpp)
+        ring = _pipeline_ring(run_layers, layers_local, h_mb, axis, vpp=vpp)
+        if isinstance(ring, tuple):
+            out, aux_sum = ring
+            if aux_to_loss is None:
+                raise ValueError(
+                    "run_layers emits aux losses (MoE router) but no "
+                    "aux_to_loss was given — dropping them silently would "
+                    "disable load balancing")
+        else:
+            out, aux_sum = ring, None
+            if aux_to_loss is not None:
+                raise ValueError(
+                    "aux_to_loss was given but run_layers returned a bare "
+                    "array — wire run_layers to return (h, aux), e.g. "
+                    "lambda lp, h: model.run_layers(lp, h, return_aux=True)")
         h_full = out.reshape((bsz,) + out.shape[2:])
 
         if shard_head and S > 1 and bsz % S == 0:
@@ -266,6 +327,14 @@ def pipelined_loss_fn(
                 jnp.mean(per_loss),
                 jnp.zeros((), per_loss.dtype),
             )
+        if aux_sum is not None:
+            # per-stage masked sums over live units; /M gives the
+            # per-microbatch mean, matching the serial run_layers aux
+            # scale (summed over layers). Stage-local contributions ride
+            # the same identity-backward psum as the head loss.
+            local = local + aux_to_loss(
+                jax.tree.map(lambda a: a / M, aux_sum)
+            ).astype(local.dtype)
         return _psum_identity_bwd(local, axis)
 
     return loss_fn
