@@ -169,7 +169,10 @@ def _pipeline_ring(
         ),
         jax.ShapeDtypeStruct(mb_shape, h_microbatches.dtype),
     )
-    with_aux = isinstance(probe, tuple)
+    returns_tuple = isinstance(probe, tuple)
+    # a dense model called with return_aux=True returns (h, None): unwrap
+    # the tuple but don't treat it as aux-emitting
+    with_aux = returns_tuple and probe[1] is not None
     aux0 = (
         jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), probe[1])
         if with_aux else None
@@ -202,6 +205,8 @@ def _pipeline_ring(
             aux_acc = jax.tree.map(
                 lambda a, v: a + jnp.where(live, v.astype(jnp.float32), 0.0),
                 aux_acc, aux)
+        elif returns_tuple:
+            h_out, _ = run_stage(chunk, h_in)
         else:
             h_out = run_stage(chunk, h_in)
         finished = (s_idx == S - 1) & (q == vpp - 1) & live
@@ -331,10 +336,12 @@ def pipelined_loss_fn(
             # per-stage masked sums over live units; /M gives the
             # per-microbatch mean, matching the serial run_layers aux
             # scale (summed over layers). Stage-local contributions ride
-            # the same identity-backward psum as the head loss.
-            local = local + aux_to_loss(
+            # the same identity-backward psum as the head loss. Promote
+            # the head loss to f32 rather than round the f32-accumulated
+            # aux down to a low-precision head dtype.
+            local = local.astype(jnp.float32) + aux_to_loss(
                 jax.tree.map(lambda a: a / M, aux_sum)
-            ).astype(local.dtype)
+            ).astype(jnp.float32)
         return _psum_identity_bwd(local, axis)
 
     return loss_fn
