@@ -522,8 +522,8 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     impl: str = "auto",
 ) -> jax.Array:
     """Fused multi-head attention.

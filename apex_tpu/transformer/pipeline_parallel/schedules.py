@@ -297,9 +297,11 @@ def pipelined_loss_fn(
             out, aux_sum = ring, None
             if aux_to_loss is not None:
                 raise ValueError(
-                    "aux_to_loss was given but run_layers returned a bare "
-                    "array — wire run_layers to return (h, aux), e.g. "
-                    "lambda lp, h: model.run_layers(lp, h, return_aux=True)")
+                    "aux_to_loss was given but run_layers emits no aux "
+                    "losses (it returned a bare array or (h, None)) — "
+                    "either the model has no aux-emitting layers (drop "
+                    "aux_to_loss) or run_layers isn't wired with "
+                    "return_aux=True")
         h_full = out.reshape((bsz,) + out.shape[2:])
 
         if shard_head and S > 1 and bsz % S == 0:

@@ -191,8 +191,8 @@ def ring_attention(
     axis: str = AXIS_CONTEXT,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     impl: str = "auto",
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``axis``.

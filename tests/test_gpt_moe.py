@@ -256,11 +256,42 @@ def test_moe_gpt_ep_x_pp_hybrid_matches_serial_microbatched():
     def hybrid_loss(r, lp, t, g):
         return collectives.pmean(pipe_loss(r, lp, t, g), ("data",))
 
-    loss = jax.jit(jax.shard_map(
-        hybrid_loss, mesh=mesh,
-        in_specs=(rspecs, lspecs, P("data"), P("data")), out_specs=P(),
+    def ref_loss(p):
+        return sum(
+            jnp.mean(serial.apply(p, toks[i * 4:(i + 1) * 4],
+                                  tgt[i * 4:(i + 1) * 4]))
+            for i in range(M)) / M
+
+    ref_grads = jax.grad(ref_loss)(params)
+
+    def loss_and_grads(r, lp, t, g):
+        loss, (gr, gl) = jax.value_and_grad(pipe_loss, argnums=(0, 1))(
+            r, lp, t, g)
+        # identity-backward psum: per-shard grads are local contributions.
+        # rest params are replicated over both axes -> sum pipe, mean data;
+        # layer grads are pipe-sharded with expert dims data-sharded ->
+        # spec-aware reduction handles both (pmean replicated dims, keep +
+        # average data-sharded expert grads locally).
+        from apex_tpu.parallel.distributed import allreduce_gradients_by_spec
+
+        gr = jax.tree.map(lambda a: jax.lax.psum(a, "pipe"), gr)
+        gr = allreduce_gradients_by_spec(
+            gr, rspecs, data_axes=("data",), replicated_axes=())
+        gl = allreduce_gradients_by_spec(
+            gl, lspecs, data_axes=("data",), replicated_axes=())
+        return collectives.pmean(loss, ("data",)), gr, gl
+
+    loss, grest, glayers = jax.jit(jax.shard_map(
+        loss_and_grads, mesh=mesh,
+        in_specs=(rspecs, lspecs, P("data"), P("data")),
+        out_specs=(P(), rspecs, lspecs),
         check_vma=False))(rest, params["layers"], toks, tgt)
     np.testing.assert_allclose(float(loss), ref, rtol=2e-5)
+    got = dict(grest, layers=glayers)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-4),
+        got, ref_grads)
 
 
 def test_moe_gpt_expert_parallel_gradients_match_serial():
