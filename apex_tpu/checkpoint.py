@@ -132,6 +132,21 @@ def save_checkpoint(directory: str, step: int, state: Any, *, backend: str = "au
         ckptr = _ocp.PyTreeCheckpointer()
         ckptr.save(os.path.abspath(path), state, force=True)
     else:
+        # guard BEFORE _flatten: its jax.device_get would raise an opaque
+        # span-non-addressable-devices error on multi-host sharded leaves
+        for keypath, leaf in jax.tree_util.tree_leaves_with_path(state):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                raise ValueError(
+                    f"npz backend is single-host only, but leaf "
+                    f"{jax.tree_util.keystr(keypath)!r} is not fully "
+                    "addressable from this process (multi-host sharded "
+                    "array). Use backend='orbax', which writes per-process "
+                    "shards without a host gather"
+                    + ("" if _ocp is not None
+                       else " (orbax failed to import in this environment; "
+                            "install it or gather the state to one host)")
+                    + "."
+                )
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "state.npz"), **_flatten(state))
     return path

@@ -150,12 +150,20 @@ class MoEMLP:
         pos_oh = jax.nn.one_hot(jnp.where(keep, pos, C), C,
                                 dtype=probs.dtype)  # (N, E, C); C -> dropped
         dispatch = pos_oh * keep[..., None]
-        # normalize gates over the k *selections* (GShard combine); a
-        # dropped expert's share is lost, NOT redistributed — renormalizing
-        # over kept gates would silently amplify the surviving expert's
-        # output ~2x under congestion
-        denom = jnp.sum(gates, axis=-1, keepdims=True)
-        combine = dispatch * (gates / jnp.maximum(denom, 1e-9))[..., None]
+        if self.top_k == 1:
+            # Switch (top-1): combine with the UNNORMALIZED router prob p_i —
+            # p_i/p_i == 1 would starve the router of task-loss gradient
+            # (one_hot(argmax) is non-differentiable), whereas scaling the
+            # expert output by p_i is exactly how Switch Transformer routes
+            # gradient to the router through the model loss.
+            combine = dispatch * gates[..., None]
+        else:
+            # k>=2: normalize gates over the k *selections* (GShard combine);
+            # a dropped expert's share is lost, NOT redistributed —
+            # renormalizing over kept gates would silently amplify the
+            # surviving expert's output ~2x under congestion
+            denom = jnp.sum(gates, axis=-1, keepdims=True)
+            combine = dispatch * (gates / jnp.maximum(denom, 1e-9))[..., None]
 
         # per-batch routing statistics; the losses combine them in
         # _aux_losses so the expert-parallel path can average stats across

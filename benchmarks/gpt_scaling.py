@@ -144,10 +144,13 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
                 n_micro=n_micro, steps=steps)
             if res is None:
+                # not enough devices — no layer count will change that;
+                # record ONE skipped row for this (dp, tp, pp) and move on
                 res = {"config": {"dp": dp, "tp": tp, "pp": pp},
                        "skipped": "not enough devices"}
-            # run_config records the effective (pp-divisible) layer count;
-            # only skipped rows fall back to the requested one
+                rows.append(res)
+                print(json.dumps(res), flush=True)
+                break
             res["config"].setdefault("layers", layers)
             eff = res["config"]["layers"]
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "layers": eff}

@@ -23,17 +23,36 @@ def _expert_ffn(params, e, x):
 
 
 def test_top1_matches_per_token_expert():
-    """With top_k=1 and ample capacity, each token's output is exactly its
-    argmax expert's FFN."""
+    """With top_k=1 and ample capacity, each token's output is its argmax
+    expert's FFN scaled by the UNNORMALIZED router prob p_i (Switch
+    Transformer combine — scaling by p_i is what carries task-loss gradient
+    into the router, since one_hot(argmax) is non-differentiable)."""
     layer = _layer(top_k=1)
     params = layer.init(jax.random.PRNGKey(0))
     x = jax.random.normal(jax.random.PRNGKey(1), (10, 8))
     out, _ = layer.apply(params, x)
     logits = np.asarray(x) @ np.asarray(params["router"]["kernel"])
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
     choice = logits.argmax(-1)
     for i in range(10):
-        ref = _expert_ffn(params, int(choice[i]), np.asarray(x[i]))
+        e = int(choice[i])
+        ref = probs[i, e] * _expert_ffn(params, e, np.asarray(x[i]))
         np.testing.assert_allclose(np.asarray(out[i]), ref, atol=1e-5)
+
+
+def test_top1_router_gets_task_loss_gradient():
+    """Switch top-1 routing must train the router through the model loss,
+    not only through the aux losses."""
+    layer = _layer(top_k=1)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (10, 8))
+
+    def task_loss(p):
+        out, _ = layer.apply(p, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(task_loss)(params)["router"]["kernel"]
+    assert float(jnp.max(jnp.abs(g))) > 0.0
 
 
 def test_top2_convex_combination():
