@@ -259,6 +259,14 @@ class GPTModel(TransformerBase):
             return logits
         return tp.vocab_parallel_cross_entropy(logits, targets, axis=c.axis)
 
+    def aux_to_loss(self, aux) -> jax.Array:
+        """Canonical (linear) fold of accumulated router aux losses into a
+        scalar loss term — the single definition shared by serial ``apply``,
+        the pipelined ``aux_to_loss`` hook, and the multi-chip gate."""
+        c = self.cfg
+        return (c.moe_aux_loss_weight * aux["load_balancing_loss"]
+                + c.moe_z_loss_weight * aux["router_z_loss"]) / c.num_layers
+
     def apply(
         self,
         params: Params,
@@ -266,7 +274,6 @@ class GPTModel(TransformerBase):
         targets: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
     ):
-        c = self.cfg
         h = self.embed(params, tokens)
         h, aux = self.run_layers(params["layers"], h, dropout_key=dropout_key,
                                  return_aux=True)
@@ -274,10 +281,7 @@ class GPTModel(TransformerBase):
         if aux is not None and targets is not None:
             # fold per-layer-averaged router losses into the per-token loss
             # (a scalar added uniformly keeps the mean-loss contract)
-            out = out + (
-                c.moe_aux_loss_weight * aux["load_balancing_loss"]
-                + c.moe_z_loss_weight * aux["router_z_loss"]
-            ).astype(out.dtype) / c.num_layers
+            out = out + self.aux_to_loss(aux).astype(out.dtype)
         return out
 
     def loss(self, params, tokens, targets, dropout_key=None) -> jax.Array:

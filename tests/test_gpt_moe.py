@@ -141,19 +141,13 @@ def test_moe_gpt_pipeline_parallel_matches_serial_microbatched():
     ref = float(ref_loss(params))
     ref_grads = jax.grad(ref_loss)(params)
 
-    c = cfg
-
-    def aux_to_loss(aux):
-        return (c.moe_aux_loss_weight * aux["load_balancing_loss"]
-                + c.moe_z_loss_weight * aux["router_z_loss"]) / c.num_layers
-
     pipe_loss = pipelined_loss_fn(
         embed=model.embed,
         run_layers=lambda lp, h: model.run_layers(lp, h, return_aux=True),
         head_loss=lambda p, h, t: model.head(p, h, t),
         num_microbatches=M,
         axis="pipe",
-        aux_to_loss=aux_to_loss,
+        aux_to_loss=model.aux_to_loss,
     )
     mesh = Mesh(np.array(devs[:2]), ("pipe",))
     all_specs = model.specs()
@@ -236,17 +230,11 @@ def test_moe_gpt_ep_x_pp_hybrid_matches_serial_microbatched():
                               tgt[i * 4:(i + 1) * 4]))
         for i in range(M)) / M)
 
-    c = ep_model.cfg
-
-    def aux_to_loss(aux):
-        return (c.moe_aux_loss_weight * aux["load_balancing_loss"]
-                + c.moe_z_loss_weight * aux["router_z_loss"]) / c.num_layers
-
     pipe_loss = pipelined_loss_fn(
         embed=ep_model.embed,
         run_layers=lambda lp, h: ep_model.run_layers(lp, h, return_aux=True),
         head_loss=lambda p, h, t: ep_model.head(p, h, t),
-        num_microbatches=M, axis="pipe", aux_to_loss=aux_to_loss)
+        num_microbatches=M, axis="pipe", aux_to_loss=ep_model.aux_to_loss)
     mesh = Mesh(np.array(devs[:4]).reshape(2, 2), ("pipe", "data"))
     specs = ep_model.specs()
     lspecs = pipeline_specs(specs["layers"])
