@@ -3,12 +3,15 @@
 
 The reference packs a batch of unequal-length sequences into one
 ``(total_tokens, 3, heads, head_dim)`` qkv tensor with ``cu_seqlens``
-boundaries and runs a flash-style kernel (fp16, seqlen ≤ 512, SM80).
-On TPU the flash kernel in ``apex_tpu.ops.flash_attention`` is the engine;
-variable length is expressed by unpacking to a padded ``(b, h, s, d)`` batch
-with a key-padding bias — XLA-friendly static shapes, one kernel launch for
-the whole batch, no per-sequence loops. The packed cu_seqlens calling
-convention is preserved.
+boundaries and runs a flash-style kernel (fp16, seqlen ≤ 512, SM80) — the
+entire point of the packed layout being that padding is never computed.
+Here the computation runs NATIVELY on the packed layout: the Pallas flash
+kernel (apex_tpu.ops.flash_attention) takes per-token segment ids derived
+from ``cu_seqlens`` and skips score blocks whose q/k segment ranges cannot
+intersect, so a batch of short sequences costs ~``sum(len_i^2)`` attention
+FLOPs — not the padded ``batch * max_seqlen^2`` — with no unpack/repack
+gathers at all. Static shapes are preserved (the packed total is padded up
+to a kernel-block multiple with a padding segment id).
 """
 
 from __future__ import annotations
@@ -18,7 +21,18 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.flash_attention import _NUM_LANES, flash_attention
+
+
+def segment_ids_from_cu_seqlens(
+    cu_seqlens: jax.Array, total: int
+) -> jax.Array:
+    """Per-token segment ids (1..batch, padding = batch+1) for a packed
+    ``cu_seqlens`` layout. Ids are non-decreasing, so the kernel's
+    contiguous-segment block skipping applies."""
+    pos = jnp.arange(total)
+    return (jnp.searchsorted(cu_seqlens[1:], pos, side="right") + 1).astype(
+        jnp.int32)
 
 
 def fmha(
@@ -34,55 +48,46 @@ def fmha(
       qkv: ``(total_tokens, 3, heads, head_dim)`` packed sequences.
       cu_seqlens: ``(batch+1,)`` cumulative sequence boundaries
         (``cu_seqlens[i]``..``cu_seqlens[i+1]`` is sequence ``i``).
-      max_seqlen: pad target (static; the reference buckets {128,256,384,512}).
-        Every sequence must fit: with concrete ``cu_seqlens`` this is
-        enforced here; under ``jit`` (traced boundaries) the caller owns the
-        guarantee — like the reference's static bucket dispatch — because a
-        longer sequence cannot be detected at trace time and its tail tokens
-        would be excluded from attention.
+      max_seqlen: envelope bound (static; the reference buckets
+        {128,256,384,512}). With concrete ``cu_seqlens`` this is enforced
+        here; under ``jit`` (traced boundaries) the caller owns the
+        guarantee, like the reference's static bucket dispatch. The packed
+        kernel itself has no per-sequence cap — the bound only preserves
+        the reference's API contract.
 
-    Returns packed ``(total_tokens, heads, head_dim)`` context.
+    Returns packed ``(total_tokens, heads, head_dim)`` context; tokens past
+    ``cu_seqlens[-1]`` (trailing padding) come back as zeros.
     """
     total, three, h, d = qkv.shape
     if three != 3:
         raise ValueError(f"expected packed qkv with dim-1 == 3, got {three}")
     b = cu_seqlens.shape[0] - 1
-    starts = cu_seqlens[:-1]
-    lengths = cu_seqlens[1:] - starts
     if not isinstance(cu_seqlens, jax.core.Tracer):
         # concrete boundaries: enforce the envelope host-side (the reference
-        # kernel rejects out-of-envelope seqlens at dispatch, fmha_api.cpp);
-        # a too-long sequence would otherwise be silently truncated to zeros.
+        # kernel rejects out-of-envelope seqlens at dispatch, fmha_api.cpp)
         import numpy as _np
 
-        max_len = int(_np.max(_np.asarray(lengths)))
+        cu = _np.asarray(cu_seqlens)
+        max_len = int(_np.max(cu[1:] - cu[:-1]))
         if max_len > max_seqlen:
             raise ValueError(
                 f"sequence length {max_len} exceeds max_seqlen {max_seqlen}"
             )
 
-    # unpack: gather each sequence's tokens into (b, max_seqlen, ...) with
-    # out-of-range rows clamped (masked out below anyway)
-    pos = jnp.arange(max_seqlen)
-    idx = jnp.minimum(starts[:, None] + pos[None, :], total - 1)  # (b, s)
-    padded = qkv[idx]  # (b, s, 3, h, d)
-    valid = pos[None, :] < lengths[:, None]  # (b, s)
+    # pad the packed row up to a lane-aligned length (padding segment id
+    # b+1 is masked inside the kernel and costs no score blocks)
+    padded_total = -(-total // _NUM_LANES) * _NUM_LANES
+    pad = padded_total - total
+    if pad:
+        qkv = jnp.pad(qkv, ((0, pad), (0, 0), (0, 0), (0, 0)))
+    seg = segment_ids_from_cu_seqlens(cu_seqlens, padded_total)[None]  # (1, T)
 
-    q = padded[:, :, 0].transpose(0, 2, 1, 3)  # (b, h, s, d)
-    k = padded[:, :, 1].transpose(0, 2, 1, 3)
-    v = padded[:, :, 2].transpose(0, 2, 1, 3)
-    bias = jnp.where(valid[:, None, None, :], 0.0, -10000.0).astype(jnp.float32)
-    ctx = flash_attention(q, k, v, bias=bias, causal=causal)  # (b, h, s, d)
-    ctx = ctx.transpose(0, 2, 1, 3)  # (b, s, h, d)
-
-    # repack: scatter valid rows back to (total, h, d)
-    flat_idx = (starts[:, None] + pos[None, :]).reshape(-1)
-    flat_valid = valid.reshape(-1)
-    flat_ctx = ctx.reshape(b * max_seqlen, h, d)
-    out = jnp.zeros((total, h, d), ctx.dtype)
-    return out.at[jnp.where(flat_valid, flat_idx, total)].set(
-        flat_ctx, mode="drop"
-    )
+    # (T, 3, h, d) -> three (1, h, T, d) — the packed row IS the sequence
+    q, k, v = (qkv[:, i].transpose(1, 0, 2)[None] for i in range(3))
+    ctx = flash_attention(
+        q, k, v, segment_ids=(seg, seg), pad_id=b + 1, causal=causal)
+    out = ctx[0].transpose(1, 0, 2)  # (T, h, d)
+    return out[:total] if pad else out
 
 
 def fmha_reference(qkv, cu_seqlens, causal=False):

@@ -165,24 +165,60 @@ class TransformerBase:
     def _attention(self, p: Params, h: jax.Array, bias=None) -> jax.Array:
         c = self.cfg
         b, s, _ = h.shape
-        qkv = self.qkv.apply(p["qkv"], h)  # (b, s, 3*H/tp)
-        # (heads, 3, head_dim) layout: a TP shard holds whole heads — the
-        # layout contract of ParallelAttention (standalone_gpt.py:560-640).
-        n_local = qkv.shape[-1] // (3 * c.head_dim)
-        qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
-        attn = self._attend(q, k, v, bias)
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
-        return self.proj.apply(p["proj"], attn)
+        # named scope = the per-op attribution key of pyprof.report (the
+        # NVTX range the reference's nvmarker.py pushes around each module)
+        with jax.named_scope("attention"):
+            qkv = self.qkv.apply(p["qkv"], h)  # (b, s, 3*H/tp)
+            # (heads, 3, head_dim) layout: a TP shard holds whole heads — the
+            # layout contract of ParallelAttention (standalone_gpt.py:560-640).
+            n_local = qkv.shape[-1] // (3 * c.head_dim)
+            qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
+            attn = self._attend(q, k, v, bias)
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
+            return self.proj.apply(p["proj"], attn)
+
+    def _positions(self, pos_table: jax.Array, s_local: int) -> jax.Array:
+        """Slice the learned position table for this shard's tokens. Under
+        sequence parallelism (``context_axis`` set) each shard's global
+        positions start at ``rank * local_seq``."""
+        ctx = getattr(self.cfg, "context_axis", None)
+        if ctx is not None:
+            start = lax.axis_index(ctx) * s_local
+            return lax.dynamic_slice_in_dim(pos_table, start, s_local, axis=0)
+        return pos_table[:s_local]
 
     def _attend(self, q, k, v, bias):
-        """Core attention on (b, nh, s, d) — the override point for
-        sequence-parallel implementations."""
-        return flash_attention(q, k, v, bias=bias, causal=self.causal,
-                               impl=self.cfg.attention_impl)
+        """Core attention on (b, nh, s, d). With ``cfg.context_axis`` set the
+        sequence dim is sharded over that mesh axis and attention runs as
+        ring (ppermute KV block exchange) or Ulysses (all_to_all head
+        exchange) sequence parallelism — shared by every model in the zoo
+        (SURVEY.md §2.3 row SP: a new capability vs the reference)."""
+        c = self.cfg
+        ctx = getattr(c, "context_axis", None)
+        if ctx is None:
+            return flash_attention(q, k, v, bias=bias, causal=self.causal,
+                                   impl=c.attention_impl)
+        from apex_tpu.transformer.ring import ring_attention, ulysses_attention
+
+        if bias is not None:
+            raise NotImplementedError(
+                "attention bias is not supported under sequence parallelism "
+                "(the ring/Ulysses paths take no bias); run with "
+                "context_axis=None for biased attention")
+        impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+        impl_name = getattr(c, "sequence_parallel_impl", "ring")
+        if impl_name not in impls:
+            raise ValueError(
+                f"sequence_parallel_impl must be 'ring' or 'ulysses', "
+                f"got {impl_name!r}")
+        return impls[impl_name](
+            q, k, v, axis=ctx, causal=self.causal, impl=c.attention_impl)
 
     def _mlp(self, p: Params, h: jax.Array) -> jax.Array:
-        return self.fc2.apply(p["fc2"], jax.nn.gelu(self.fc1.apply(p["fc1"], h)))
+        with jax.named_scope("mlp"):
+            return self.fc2.apply(
+                p["fc2"], jax.nn.gelu(self.fc1.apply(p["fc1"], h)))
 
     def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
         raise NotImplementedError

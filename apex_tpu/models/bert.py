@@ -59,6 +59,11 @@ class BertConfig:
     remat: bool = True
     add_binary_head: bool = True
     attention_impl: str = "auto"
+    # sequence (context) parallelism over this mesh axis — the shared
+    # TransformerBase._attend ring/Ulysses path (bidirectional here);
+    # incompatible with a padding attention_mask (the ring takes no bias)
+    context_axis: Optional[str] = None
+    sequence_parallel_impl: str = "ring"  # 'ring' | 'ulysses'
 
     @property
     def ffn(self) -> int:
@@ -87,6 +92,18 @@ class BertModel(TransformerBase):
     """
 
     causal = False
+
+    def __init__(self, config):
+        if config.context_axis is not None and config.add_binary_head:
+            # pooling reads h[:, 0]; under sequence sharding that is each
+            # shard's LOCAL first token, not the global [CLS] — the NSP
+            # logits would be silently wrong on every rank but 0
+            raise ValueError(
+                "add_binary_head=True is incompatible with context_axis "
+                "(the pooler needs the global [CLS] token, but the sequence "
+                "dim is sharded); set add_binary_head=False under sequence "
+                "parallelism")
+        super().__init__(config)
 
     # -- parameters ---------------------------------------------------------
 
@@ -147,12 +164,13 @@ class BertModel(TransformerBase):
         dropout_key: Optional[jax.Array] = None,
     ) -> jax.Array:
         c = self.cfg
-        h = self.embedding.apply(params["embedding"], tokens)
-        h = h + params["position"][: tokens.shape[-1]]
-        if tokentype_ids is not None:
-            h = h + jnp.take(params["tokentype"], tokentype_ids, axis=0)
-        h = self._ln(params["ln_emb"], h.astype(c.compute_dtype))
-        return self._dropout(h, dropout_key).astype(c.compute_dtype)
+        with jax.named_scope("embed"):
+            h = self.embedding.apply(params["embedding"], tokens)
+            h = h + self._positions(params["position"], tokens.shape[-1])
+            if tokentype_ids is not None:
+                h = h + jnp.take(params["tokentype"], tokentype_ids, axis=0)
+            h = self._ln(params["ln_emb"], h.astype(c.compute_dtype))
+            return self._dropout(h, dropout_key).astype(c.compute_dtype)
 
     def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
         """Post-LN block: LN(residual + sublayer(h))."""
@@ -170,22 +188,24 @@ class BertModel(TransformerBase):
         """MLM decode (+ binary logits). With labels: per-token vocab-parallel
         CE (post_language_model_processing, standalone_bert.py:76-98)."""
         c = self.cfg
-        binary_logits = None
-        if c.add_binary_head:
-            pooled = jnp.tanh(self._dense(params["pooler"], h[:, 0]))
-            binary_logits = self._dense(params["binary_head"],
-                                        pooled.astype(jnp.float32))
-        g = jax.nn.gelu(self._dense(params["lm_dense"], h))
-        g = self._ln(params["lm_ln"], g)
-        if c.axis is not None:
-            g = tp.copy_to_tensor_model_parallel_region(g, c.axis)
-        wte = params["embedding"]["embedding"].astype(g.dtype)  # (V/tp, H)
-        logits = jnp.einsum("bsh,vh->bsv", g, wte) + params["lm_bias"].astype(g.dtype)
-        if masked_lm_labels is None:
-            return logits, binary_logits
-        lm_loss = tp.vocab_parallel_cross_entropy(
-            logits, masked_lm_labels, axis=c.axis)
-        return lm_loss, binary_logits
+        with jax.named_scope("head"):
+            binary_logits = None
+            if c.add_binary_head:
+                pooled = jnp.tanh(self._dense(params["pooler"], h[:, 0]))
+                binary_logits = self._dense(params["binary_head"],
+                                            pooled.astype(jnp.float32))
+            g = jax.nn.gelu(self._dense(params["lm_dense"], h))
+            g = self._ln(params["lm_ln"], g)
+            if c.axis is not None:
+                g = tp.copy_to_tensor_model_parallel_region(g, c.axis)
+            wte = params["embedding"]["embedding"].astype(g.dtype)  # (V/tp, H)
+            logits = (jnp.einsum("bsh,vh->bsv", g, wte)
+                      + params["lm_bias"].astype(g.dtype))
+            if masked_lm_labels is None:
+                return logits, binary_logits
+            lm_loss = tp.vocab_parallel_cross_entropy(
+                logits, masked_lm_labels, axis=c.axis)
+            return lm_loss, binary_logits
 
     def apply(
         self,

@@ -35,7 +35,6 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models._transformer import TransformerBase
@@ -180,36 +179,10 @@ class GPTModel(TransformerBase):
 
     def embed(self, params: Params, tokens: jax.Array) -> jax.Array:
         c = self.cfg
-        h = self.embedding.apply(params["embedding"], tokens)
-        s_local = tokens.shape[-1]
-        if c.context_axis is not None:
-            # sequence sharded: this shard's global positions start at
-            # rank * local_seq
-            start = lax.axis_index(c.context_axis) * s_local
-            pos = lax.dynamic_slice_in_dim(
-                params["position"], start, s_local, axis=0)
-        else:
-            pos = params["position"][:s_local]
-        return (h + pos).astype(c.compute_dtype)
-
-    def _attend(self, q, k, v, bias):
-        c = self.cfg
-        if c.context_axis is None:
-            return super()._attend(q, k, v, bias)
-        from apex_tpu.transformer.ring import ring_attention, ulysses_attention
-
-        if bias is not None:
-            raise NotImplementedError(
-                "attention bias is not supported under sequence parallelism "
-                "(the ring/Ulysses paths take no bias); run with "
-                "context_axis=None for biased attention")
-        impls = {"ring": ring_attention, "ulysses": ulysses_attention}
-        if c.sequence_parallel_impl not in impls:
-            raise ValueError(
-                f"sequence_parallel_impl must be 'ring' or 'ulysses', "
-                f"got {c.sequence_parallel_impl!r}")
-        return impls[c.sequence_parallel_impl](
-            q, k, v, axis=c.context_axis, causal=True, impl=c.attention_impl)
+        with jax.named_scope("embed"):
+            h = self.embedding.apply(params["embedding"], tokens)
+            pos = self._positions(params["position"], tokens.shape[-1])
+            return (h + pos).astype(c.compute_dtype)
 
     def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
         """Pre-LN block: residual + sublayer(LN(h))."""
@@ -245,19 +218,21 @@ class GPTModel(TransformerBase):
         """Final LN + tied LM head (+ per-token loss when targets given)
         (post_language_model_processing, standalone_gpt.py:1361+)."""
         c = self.cfg
-        h = self._ln(params["ln_f"], h)
-        if c.axis is None and c.lm_head_chunks and targets is not None:
-            from apex_tpu.ops.lm_head_loss import lm_head_cross_entropy
+        with jax.named_scope("head"):
+            h = self._ln(params["ln_f"], h)
+            if c.axis is None and c.lm_head_chunks and targets is not None:
+                from apex_tpu.ops.lm_head_loss import lm_head_cross_entropy
 
-            return lm_head_cross_entropy(
-                h, params["embedding"]["embedding"], targets, c.lm_head_chunks)
-        wte = params["embedding"]["embedding"].astype(h.dtype)  # (V/tp, H)
-        if c.axis is not None:
-            h = tp.copy_to_tensor_model_parallel_region(h, c.axis)
-        logits = jnp.einsum("bsh,vh->bsv", h, wte)  # vocab-sharded logits
-        if targets is None:
-            return logits
-        return tp.vocab_parallel_cross_entropy(logits, targets, axis=c.axis)
+                return lm_head_cross_entropy(
+                    h, params["embedding"]["embedding"], targets,
+                    c.lm_head_chunks)
+            wte = params["embedding"]["embedding"].astype(h.dtype)  # (V/tp, H)
+            if c.axis is not None:
+                h = tp.copy_to_tensor_model_parallel_region(h, c.axis)
+            logits = jnp.einsum("bsh,vh->bsv", h, wte)  # vocab-sharded logits
+            if targets is None:
+                return logits
+            return tp.vocab_parallel_cross_entropy(logits, targets, axis=c.axis)
 
     def aux_to_loss(self, aux) -> jax.Array:
         """Canonical (linear) fold of accumulated router aux losses into a

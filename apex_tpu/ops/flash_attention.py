@@ -20,7 +20,12 @@ over q-blocks for dQ, one over k-blocks for dK/dV.
 Masking: ``causal=True`` for the upper-triangular variant, and/or an additive
 ``bias`` broadcastable to ``(b, h, sq, sk)`` (the additive-mask path of
 fast_multihead_attn; boolean masks become ``-10000`` biases upstream, matching
-the reference's masked_fill value).
+the reference's masked_fill value), and/or ``segment_ids`` — packed-varlen
+attention (the reference fmha's cu_seqlens contract, fmha.py:33-74): tokens
+attend only within their segment, and for the contiguous (non-decreasing-ids)
+layout the kernel SKIPS score blocks whose q/k segment ranges cannot
+intersect, so a batch of short sequences pays ~sum(len_i^2) FLOPs instead of
+the padded total^2 — the entire point of the reference's packed kernel.
 """
 
 from __future__ import annotations
@@ -37,13 +42,19 @@ from jax.experimental.pallas import tpu as pltpu
 from apex_tpu.ops.layer_norm import _interpret, _resolve_impl
 
 _NEG_INF = -1e30
+# TPU vreg geometry: segment ids ride in a lane-major layout (q ids
+# replicated over lanes, kv ids over sublanes) so the in-kernel equality
+# test is a plain vector compare — the standard Pallas idiom.
+_NUM_LANES = 128
+_NUM_SUBLANES = 8
 
 
-def _pick_block(n: int, target: int) -> int:
-    """Largest multiple-of-8 divisor of n that is <= target (n if none)."""
+def _pick_block(n: int, target: int, mult: int = 8) -> int:
+    """Largest multiple-of-``mult`` divisor of n that is <= target (n if
+    none)."""
     best = None
-    for cand in range(min(n, target), 7, -1):
-        if n % cand == 0 and cand % 8 == 0:
+    for cand in range(min(n, target), mult - 1, -1):
+        if n % cand == 0 and cand % mult == 0:
             best = cand
             break
     return best if best is not None else n
@@ -63,7 +74,41 @@ def _supported(sq: int, sk: int, d: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, off_ref, o_ref, lse_ref, *, scale, causal, blk_q, blk_k):
+def _seg_mask(s, q_ids, ks_ref, j, blk_k, pad_id):
+    """Mask ``s`` (blk_q, blk_k) to -inf where the q/k segment ids differ
+    (or the key is padding). ``q_ids`` is the lane-replicated (blk_q, 128)
+    tile; kv ids arrive sublane-replicated (slices of (SUBLANES, sk))."""
+    q_col = jnp.tile(q_ids, (1, s.shape[-1] // _NUM_LANES))
+    k_ids = ks_ref[0, 0:1, pl.ds(j * blk_k, blk_k)]
+    valid = q_col == k_ids
+    if pad_id is not None:
+        valid = valid & (k_ids != pad_id)
+    return jnp.where(valid, s, _NEG_INF)
+
+
+def _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, j, blk_k, pad_id,
+                        qmin, qmax):
+    """Apply the segment mask only on blocks that need it — the splash-
+    attention full/partial block distinction: an interior block whose q and
+    k segment ranges are the same single (non-pad) segment is fully valid,
+    so the mask (the dominant vector cost of the segment path) is skipped
+    via a real branch. ``kmm_ref`` holds per-k-block (min, max) ids in SMEM."""
+    kmin = kmm_ref[0, 0, j]
+    kmax = kmm_ref[0, 1, j]
+    uniform_ok = (qmin == qmax) & (kmin == kmax) & (kmin == qmin)
+    if pad_id is not None:
+        uniform_ok = uniform_ok & (qmin != pad_id)
+    return jax.lax.cond(
+        uniform_ok,
+        lambda s: s,
+        lambda s: _seg_mask(s, qs_ref[0], ks_ref, j, blk_k, pad_id),
+        s,
+    )
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref,
+                off_ref, o_ref, lse_ref, *, scale, causal, blk_q, blk_k,
+                pad_id):
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
     sk = k_ref.shape[2]
     d = q.shape[-1]
@@ -74,6 +119,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, off_ref, o_ref, lse_ref, *, scale, c
     # is correct across sequence shards; 0 for unsharded attention).
     q_off = off_ref[0] if off_ref is not None else 0
     k_off = off_ref[1] if off_ref is not None else 0
+    if qs_ref is not None:
+        # this q block's segment-id range, once per program
+        qmin = jnp.min(qs_ref[0])
+        qmax = jnp.max(qs_ref[0])
 
     def body(j, carry):
         acc, m, l = carry
@@ -84,12 +133,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, off_ref, o_ref, lse_ref, *, scale, c
         )  # (blk_q, blk_k)
         if b_ref is not None:
             s = s + b_ref[0, 0, :, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+        if qs_ref is not None:
+            s = _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, j, blk_k,
+                                    pad_id, qmin, qmax)
         if causal:
             q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_off + j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos > q_pos, _NEG_INF, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # fully-masked rows keep m == -inf: exp(s - m) would be exp(0);
+        # zero their probabilities so l stays 0 and the output stays 0
+        p = jnp.where(m_new <= _NEG_INF / 2, 0.0, jnp.exp(s - m_new))
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot(
@@ -100,14 +154,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, off_ref, o_ref, lse_ref, *, scale, c
     acc = jnp.zeros((blk_q, d), jnp.float32)
     m0 = jnp.full((blk_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    lo = 0
+    if bnd_ref is not None:
+        # contiguous-segment block bounds (precomputed host-side): k blocks
+        # outside [lo, hi) cannot share a segment with this q block — the
+        # packed-varlen FLOP saving (sum len_i^2, not total^2)
+        lo = bnd_ref[0, 0, qi]
+        nk = jnp.minimum(nk, bnd_ref[0, 1, qi])
     if causal:
         # skip k-blocks strictly above the diagonal (fully masked): the
         # triangular-work saving the reference's upper-triang kernel gets
         # from its tiling (scaled_upper_triang_masked_softmax.h).
         lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
         nk = jnp.clip(lim, 0, nk)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m0, l0))
-    # Fully-masked rows (possible with an all -inf bias row) have l == 0.
+    acc, m, l = jax.lax.fori_loop(lo, nk, body, (acc, m0, l0))
+    # Fully-masked rows (padding segments, all -inf bias rows) have l == 0.
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0, 0] = m + jnp.log(l_safe)
@@ -119,8 +180,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, off_ref, o_ref, lse_ref, *, scale, c
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, b_ref, off_ref, do_ref, lse_ref, delta_ref, dq_ref, db_ref,
-    *, scale, causal, blk_q, blk_k, b_bcast, h_bcast, dims,
+    q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref, off_ref,
+    do_ref, lse_ref, delta_ref, dq_ref, db_ref,
+    *, scale, causal, blk_q, blk_k, pad_id, b_bcast, h_bcast, dims,
 ):
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
@@ -133,6 +195,9 @@ def _bwd_dq_kernel(
     nk = sk // blk_k
     q_off = off_ref[0] if off_ref is not None else 0
     k_off = off_ref[1] if off_ref is not None else 0
+    if qs_ref is not None:
+        qmin = jnp.min(qs_ref[0])
+        qmax = jnp.max(qs_ref[0])
 
     if db_ref is not None:
         # A bias broadcast over batch/heads maps several grid steps onto the
@@ -165,11 +230,15 @@ def _bwd_dq_kernel(
         )
         if b_ref is not None:
             s = s + b_ref[0, 0, :, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+        if qs_ref is not None:
+            s = _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, j, blk_k,
+                                    pad_id, qmin, qmax)
         if causal:
             q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_off + j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos > q_pos, _NEG_INF, s)
-        p = jnp.exp(s - lse)
+        # fully-masked rows carry lse == -inf; exp(s - lse) would be exp(0)
+        p = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -179,16 +248,21 @@ def _bwd_dq_kernel(
             db_ref[0, 0, :, pl.ds(j * blk_k, blk_k)] = cur + ds
         return dq + scale * jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
+    lo = 0
+    if bnd_ref is not None:
+        lo = bnd_ref[0, 0, qi]
+        nk = jnp.minimum(nk, bnd_ref[0, 1, qi])
     if causal:
         lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
         nk = jnp.clip(lim, 0, nk)
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros_like(q))
+    dq = jax.lax.fori_loop(lo, nk, body, jnp.zeros_like(q))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, b_ref, off_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, scale, causal, blk_q, blk_k,
+    q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, qmm_ref, kmm_ref, bnd_ref,
+    off_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal, blk_q, blk_k, pad_id,
 ):
     k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, d)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -197,6 +271,19 @@ def _bwd_dkv_kernel(
     nq = sq // blk_q
     q_off = off_ref[0] if off_ref is not None else 0
     k_off = off_ref[1] if off_ref is not None else 0
+    if qs_ref is not None:
+        # this k block's segment-id range, once per program (SMEM metadata)
+        kmin = kmm_ref[0, 0, ki]
+        kmax = kmm_ref[0, 1, ki]
+
+    def seg_mask_dkv(s, i):
+        q_ids = jnp.tile(qs_ref[0, pl.ds(i * blk_q, blk_q), :],
+                         (1, blk_k // _NUM_LANES))
+        k_ids = ks_ref[0, 0:1, pl.ds(ki * blk_k, blk_k)]
+        valid = q_ids == k_ids
+        if pad_id is not None:
+            valid = valid & (k_ids != pad_id)
+        return jnp.where(valid, s, _NEG_INF)
 
     def body(i, carry):
         dk, dv = carry
@@ -209,11 +296,20 @@ def _bwd_dkv_kernel(
         )  # (blk_q, blk_k)
         if b_ref is not None:
             s = s + b_ref[0, 0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
+        if qs_ref is not None:
+            qmin = qmm_ref[0, 0, i]
+            qmax = qmm_ref[0, 1, i]
+            uniform_ok = (qmin == qmax) & (kmin == kmax) & (kmin == qmin)
+            if pad_id is not None:
+                uniform_ok = uniform_ok & (qmin != pad_id)
+            s = jax.lax.cond(uniform_ok, lambda s: s,
+                             lambda s: seg_mask_dkv(s, i), s)
         if causal:
             q_pos = q_off + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_off + ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos > q_pos, _NEG_INF, s)
-        p = jnp.exp(s - lse)  # (blk_q, blk_k)
+        # fully-masked rows carry lse == -inf; exp(s - lse) would be exp(0)
+        p = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(s - lse))  # (blk_q, blk_k)
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -231,6 +327,10 @@ def _bwd_dkv_kernel(
     # Under causal masking, q-blocks entirely left of this k-block's diagonal
     # contribute nothing — start at the first intersecting block.
     start = jnp.clip((k_off - q_off + ki * blk_k) // blk_q, 0, nq) if causal else 0
+    if bnd_ref is not None:
+        # contiguous-segment bounds over q blocks for this k block
+        start = jnp.maximum(start, bnd_ref[0, 0, ki])
+        nq = jnp.minimum(nq, bnd_ref[0, 1, ki])
     dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
@@ -273,10 +373,89 @@ def _offsets_spec():
     return pl.BlockSpec((2,), lambda *_: (0,), memory_space=pltpu.SMEM)
 
 
+def _seg_layouts(q_seg, kv_seg):
+    """Lane/sublane-replicated segment-id layouts for the kernels:
+    q ids ``(b, sq, NUM_LANES)``, kv ids ``(b, NUM_SUBLANES, sk)``."""
+    b, sq = q_seg.shape
+    sk = kv_seg.shape[1]
+    qs = jax.lax.broadcast_in_dim(
+        q_seg.astype(jnp.int32), (b, sq, _NUM_LANES), (0, 1))
+    ks = jax.lax.broadcast_in_dim(
+        kv_seg.astype(jnp.int32), (b, _NUM_SUBLANES, sk), (0, 2))
+    return qs, ks
+
+
+def _seg_metadata(q_seg, kv_seg, blk_q, blk_k, pad_id=None):
+    """Per-block metadata for CONTIGUOUS (non-decreasing) segment ids.
+
+    Returns ``(bounds_q, bounds_k, qmm, kmm)``: ``bounds_q[b, 0/1, i]`` is
+    the [start, end) k-block range intersecting q block ``i``'s segment span
+    (symmetrically ``bounds_k`` over q blocks), and ``qmm``/``kmm`` are the
+    per-block (min, max) segment ids — the full/partial block classifier.
+    With ``pad_id`` set, all-padding blocks get EMPTY ranges and ranges
+    never extend into the all-padding suffix, so trailing padding costs no
+    score blocks at all. Computed with plain XLA reductions OUTSIDE the
+    kernel and read from SMEM inside — the Pallas-native replacement for
+    the reference kernel's cu_seqlens binary search per CTA (fmha kernel
+    launch geometry)."""
+    b, sq = q_seg.shape
+    sk = kv_seg.shape[1]
+    nq, nk = sq // blk_q, sk // blk_k
+    qb = q_seg.reshape(b, nq, blk_q)
+    kb = kv_seg.reshape(b, nk, blk_k)
+    qmin, qmax = qb.min(-1), qb.max(-1)  # (b, nq)
+    kmin, kmax = kb.min(-1), kb.max(-1)  # (b, nk)
+    # monotone ids: blocks wholly before/after the span count as offsets
+    start_q = jnp.sum(kmax[:, None, :] < qmin[:, :, None], axis=-1)
+    end_q = nk - jnp.sum(kmin[:, None, :] > qmax[:, :, None], axis=-1)
+    start_k = jnp.sum(qmax[:, None, :] < kmin[:, :, None], axis=-1)
+    end_k = nq - jnp.sum(qmin[:, None, :] > kmax[:, :, None], axis=-1)
+    if pad_id is not None:
+        # monotone ids put all-padding blocks (min == pad) in a suffix:
+        # give them empty ranges and stop every range at the suffix
+        real_k = nk - jnp.sum(kmin == pad_id, axis=-1, keepdims=True)
+        end_q = jnp.minimum(end_q, real_k)
+        pad_q = qmin == pad_id
+        start_q = jnp.where(pad_q, 0, start_q)
+        end_q = jnp.where(pad_q, 0, end_q)
+        real_q = nq - jnp.sum(qmin == pad_id, axis=-1, keepdims=True)
+        end_k = jnp.minimum(end_k, real_q)
+        pad_k = kmin == pad_id
+        start_k = jnp.where(pad_k, 0, start_k)
+        end_k = jnp.where(pad_k, 0, end_k)
+    bounds_q = jnp.stack([start_q, end_q], axis=1).astype(jnp.int32)
+    bounds_k = jnp.stack([start_k, end_k], axis=1).astype(jnp.int32)
+    qmm = jnp.stack([qmin, qmax], axis=1).astype(jnp.int32)  # (b, 2, nq)
+    kmm = jnp.stack([kmin, kmax], axis=1).astype(jnp.int32)  # (b, 2, nk)
+    return bounds_q, bounds_k, qmm, kmm
+
+
+def _seg_specs(blk_q, sk, reorder=None):
+    """(q-ids, kv-ids) BlockSpecs for grids ordered (b, h, q)."""
+    r = reorder if reorder is not None else (lambda f: f)
+    return [
+        pl.BlockSpec((1, blk_q, _NUM_LANES),
+                     r(lambda bi, hi, qi: (bi, qi, 0)),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, _NUM_SUBLANES, sk),
+                     r(lambda bi, hi, qi: (bi, 0, 0)),
+                     memory_space=pltpu.VMEM),
+    ]
+
+
+def _smem_pair_spec(n, reorder=None):
+    """SMEM spec for a (b, 2, n) per-block metadata array (bounds, min/max)."""
+    r = reorder if reorder is not None else (lambda f: f)
+    return pl.BlockSpec((1, 2, n), r(lambda bi, hi, qi: (bi, 0, 0)),
+                        memory_space=pltpu.SMEM)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("scale", "causal", "blk_q", "blk_k")
+    jax.jit,
+    static_argnames=("scale", "causal", "blk_q", "blk_k", "pad_id", "contiguous"),
 )
-def _flash_fwd(q, k, v, bias, offsets, *, scale, causal, blk_q, blk_k):
+def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
+               scale, causal, blk_q, blk_k, pad_id=None, contiguous=True):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     grid = (b, h, sq // blk_q)
@@ -292,10 +471,22 @@ def _flash_fwd(q, k, v, bias, offsets, *, scale, causal, blk_q, blk_k):
     if bias is not None:
         in_specs.append(_bias_spec(bias, blk_q, sk))
         args.append(bias)
+    if q_seg is not None:
+        qs, ks = _seg_layouts(q_seg, kv_seg)
+        bounds_q, _, _, kmm = _seg_metadata(q_seg, kv_seg, blk_q, blk_k,
+                                            pad_id)
+        in_specs += _seg_specs(blk_q, sk)
+        args += [qs, ks]
+        in_specs.append(_smem_pair_spec(sk // blk_k))
+        args.append(kmm)
+        if contiguous:
+            in_specs.append(_smem_pair_spec(sq // blk_q))
+            args.append(bounds_q)
     if offsets is not None:
         in_specs.append(_offsets_spec())
         args.append(offsets)
     has_bias, has_off = bias is not None, offsets is not None
+    has_seg, has_bnd = q_seg is not None, q_seg is not None and contiguous
 
     def kern(*refs):
         refs = list(refs)
@@ -303,11 +494,18 @@ def _flash_fwd(q, k, v, bias, offsets, *, scale, causal, blk_q, blk_k):
         i = 3
         br = refs[i] if has_bias else None
         i += has_bias
+        qsr = refs[i] if has_seg else None
+        ksr = refs[i + 1] if has_seg else None
+        kmmr = refs[i + 2] if has_seg else None
+        i += 3 * has_seg
+        bndr = refs[i] if has_bnd else None
+        i += has_bnd
         offr = refs[i] if has_off else None
         i += has_off
         orf, lr = refs[i], refs[i + 1]
-        _fwd_kernel(qr, kr, vr, br, offr, orf, lr,
-                    scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+        _fwd_kernel(qr, kr, vr, br, qsr, ksr, kmmr, bndr, offr, orf, lr,
+                    scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+                    pad_id=pad_id)
 
     o, lse = pl.pallas_call(
         kern,
@@ -330,13 +528,21 @@ def _flash_fwd(q, k, v, bias, offsets, *, scale, causal, blk_q, blk_k):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "causal", "blk_q", "blk_k")
+    jax.jit,
+    static_argnames=("scale", "causal", "blk_q", "blk_k", "pad_id", "contiguous"),
 )
-def _flash_bwd(q, k, v, bias, offsets, o, lse, do, *, scale, causal, blk_q, blk_k):
+def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
+               scale, causal, blk_q, blk_k, pad_id=None, contiguous=True):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
                     keepdims=True)  # (b, h, sq, 1)
+    has_seg = q_seg is not None
+    has_bnd = has_seg and contiguous
+    if has_seg:
+        qs_l, ks_l = _seg_layouts(q_seg, kv_seg)
+        bounds_q, bounds_k, qmm, kmm = _seg_metadata(
+            q_seg, kv_seg, blk_q, blk_k, pad_id)
 
     # dQ pass: grid over (b, h, q-blocks), reordered so dbias accumulation
     # over broadcast dims happens on consecutive steps (see _dq_grid_order);
@@ -373,6 +579,14 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, *, scale, causal, blk_q, blk_
             memory_space=pltpu.VMEM,
         ))
         args.append(bias)
+    if has_seg:
+        in_specs += _seg_specs(blk_q, sk, reorder=reorder)
+        args += [qs_l, ks_l]
+        in_specs.append(_smem_pair_spec(sk // blk_k, reorder=reorder))
+        args.append(kmm)
+        if has_bnd:
+            in_specs.append(_smem_pair_spec(sq // blk_q, reorder=reorder))
+            args.append(bounds_q)
     if offsets is not None:
         in_specs.append(_offsets_spec())
         args.append(offsets)
@@ -386,13 +600,21 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, *, scale, causal, blk_q, blk_
         i = 3
         br = refs[i] if has_bias else None
         i += has_bias
+        qsr = refs[i] if has_seg else None
+        ksr = refs[i + 1] if has_seg else None
+        kmmr = refs[i + 2] if has_seg else None
+        i += 3 * has_seg
+        bndr = refs[i] if has_bnd else None
+        i += has_bnd
         offr = refs[i] if has_off else None
         i += has_off
         dor, lr, dr, dqr = refs[i:i + 4]
         dbr = refs[i + 4] if has_bias else None
-        _bwd_dq_kernel(qr, kr, vr, br, offr, dor, lr, dr, dqr, dbr,
+        _bwd_dq_kernel(qr, kr, vr, br, qsr, ksr, kmmr, bndr, offr, dor, lr,
+                       dr, dqr, dbr,
                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                       b_bcast=b_bcast, h_bcast=h_bcast, dims=dims)
+                       pad_id=pad_id, b_bcast=b_bcast, h_bcast=h_bcast,
+                       dims=dims)
 
     out_specs = [qspec]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
@@ -431,6 +653,26 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, *, scale, causal, blk_q, blk_
         )
         in_specs2.append(bspec2)
         args2.append(bias)
+    if has_seg:
+        # this pass streams q: q ids arrive FULL, bounds indexed by k block
+        in_specs2 += [
+            pl.BlockSpec((1, sq, _NUM_LANES),
+                         lambda bi, hi, ki: (bi, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _NUM_SUBLANES, sk),
+                         lambda bi, hi, ki: (bi, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, sq // blk_q), lambda bi, hi, ki: (bi, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2, sk // blk_k), lambda bi, hi, ki: (bi, 0, 0),
+                         memory_space=pltpu.SMEM),
+        ]
+        args2 += [qs_l, ks_l, qmm, kmm]
+        if has_bnd:
+            in_specs2.append(pl.BlockSpec(
+                (1, 2, sk // blk_k), lambda bi, hi, ki: (bi, 0, 0),
+                memory_space=pltpu.SMEM))
+            args2.append(bounds_k)
     if offsets is not None:
         in_specs2.append(_offsets_spec())
         args2.append(offsets)
@@ -443,11 +685,20 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, *, scale, causal, blk_q, blk_
         i = 3
         br = refs[i] if has_bias else None
         i += has_bias
+        qsr = refs[i] if has_seg else None
+        ksr = refs[i + 1] if has_seg else None
+        qmmr = refs[i + 2] if has_seg else None
+        kmmr = refs[i + 3] if has_seg else None
+        i += 4 * has_seg
+        bndr = refs[i] if has_bnd else None
+        i += has_bnd
         offr = refs[i] if has_off else None
         i += has_off
         dor, lr, dr, dkr, dvr = refs[i:i + 5]
-        _bwd_dkv_kernel(qr, kr, vr, br, offr, dor, lr, dr, dkr, dvr,
-                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+        _bwd_dkv_kernel(qr, kr, vr, br, qsr, ksr, qmmr, kmmr, bndr, offr,
+                        dor, lr, dr, dkr, dvr,
+                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+                        pad_id=pad_id)
 
     dk, dv = pl.pallas_call(
         dkv_kern,
@@ -468,26 +719,33 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, *, scale, causal, blk_q, blk_
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, bias, scale, causal, blk_q, blk_k):
-    o, _ = _flash_fwd(q, k, v, bias, None, scale=scale, causal=causal,
-                      blk_q=blk_q, blk_k=blk_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, q_seg, kv_seg, scale, causal, blk_q, blk_k,
+           pad_id, contiguous):
+    o, _ = _flash_fwd(q, k, v, bias, None, q_seg, kv_seg,
+                      scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+                      pad_id=pad_id, contiguous=contiguous)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, bias, scale, causal, blk_q, blk_k):
-    o, lse = _flash_fwd(q, k, v, bias, None, scale=scale, causal=causal,
-                        blk_q=blk_q, blk_k=blk_k)
-    return o, (q, k, v, bias, o, lse)
+def _flash_vjp_fwd(q, k, v, bias, q_seg, kv_seg, scale, causal, blk_q, blk_k,
+                   pad_id, contiguous):
+    o, lse = _flash_fwd(q, k, v, bias, None, q_seg, kv_seg,
+                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+                        pad_id=pad_id, contiguous=contiguous)
+    return o, (q, k, v, bias, q_seg, kv_seg, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, blk_q, blk_k, res, do):
-    q, k, v, bias, o, lse = res
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, None, o, lse, do, scale=scale,
-                                   causal=causal, blk_q=blk_q, blk_k=blk_k)
+def _flash_vjp_bwd(scale, causal, blk_q, blk_k, pad_id, contiguous, res, do):
+    q, k, v, bias, q_seg, kv_seg, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, None, o, lse, do,
+                                   q_seg, kv_seg, scale=scale,
+                                   causal=causal, blk_q=blk_q, blk_k=blk_k,
+                                   pad_id=pad_id, contiguous=contiguous)
     if dbias is not None:
         dbias = dbias.astype(bias.dtype)
-    return dq, dk, dv, dbias
+    # segment ids are integer inputs: symbolically-zero cotangents
+    return dq, dk, dv, dbias, None, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -497,6 +755,8 @@ def mha_reference(
     q: jax.Array, k: jax.Array, v: jax.Array,
     bias: Optional[jax.Array] = None,
     *, causal: bool = False, scale: Optional[float] = None,
+    segment_ids: Optional[Tuple[jax.Array, jax.Array]] = None,
+    pad_id: Optional[int] = None,
 ) -> jax.Array:
     """Unfused XLA attention (the torch-softmax fallback path,
     fused_softmax.py:193-199 forward_torch_softmax equivalent)."""
@@ -505,12 +765,24 @@ def mha_reference(
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    fully_masked = None
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        valid = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+        if pad_id is not None:
+            valid = valid & (kv_seg != pad_id)[:, None, None, :]
+        s = jnp.where(valid, s, _NEG_INF)
+        fully_masked = ~jnp.any(valid, axis=-1, keepdims=True)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         q_pos = jnp.arange(sq)[:, None]
         k_pos = jnp.arange(sk)[None, :]
         s = jnp.where(k_pos > q_pos, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    if fully_masked is not None:
+        # match the kernel: rows with no visible key output exactly zero
+        # (softmax of an all -inf row would be uniform, not zero)
+        p = jnp.where(fully_masked, 0.0, p)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
@@ -520,6 +792,9 @@ def flash_attention(
     v: jax.Array,
     bias: Optional[jax.Array] = None,
     *,
+    segment_ids: Optional[Tuple[jax.Array, jax.Array]] = None,
+    pad_id: Optional[int] = None,
+    contiguous_segments: bool = True,
     causal: bool = False,
     scale: Optional[float] = None,
     block_q: int = 1024,
@@ -534,6 +809,18 @@ def flash_attention(
       bias: optional additive bias broadcastable to ``(b, h, sq, sk)``
         (additive-mask attention; use -10000 for masked positions like the
         reference's masked_fill).
+      segment_ids: optional ``(q_seg, kv_seg)`` int arrays of shape
+        ``(b, sq)`` / ``(b, sk)``: a query attends only keys with an EQUAL
+        segment id — packed-varlen attention (the reference fmha's
+        cu_seqlens semantics, apex/contrib/fmha/fmha.py:33-74). Rows whose
+        every key is masked output exactly 0.
+      pad_id: segment id marking padding: such keys are never attended
+        (and padded query rows output 0).
+      contiguous_segments: ids are non-decreasing along the sequence (the
+        packed layout). Enables block skipping: k blocks whose segment
+        range cannot intersect the q block's are never computed, so cost
+        scales with ``sum(len_i^2)`` instead of ``total^2``. Set False for
+        non-monotone id layouts (mask-only, no skipping).
       causal: upper-triangular masking (scaled_upper_triang_masked_softmax).
       scale: score scale; defaults to 1/sqrt(head_dim).
       impl: 'auto' | 'pallas' | 'xla'.
@@ -544,10 +831,35 @@ def flash_attention(
     use = _resolve_impl(impl)
     if use == "pallas" and not _supported(sq, sk, d):
         use = "xla"
-    if use == "xla":
-        return mha_reference(q, k, v, bias, causal=causal, scale=scale)
     blk_q = _pick_block(sq, block_q)
     blk_k = _pick_block(sk, block_k)
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        if q_seg.shape != (b, sq) or kv_seg.shape != (b, sk):
+            raise ValueError(
+                f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} do not "
+                f"match (batch, seq) = ({b}, {sq})/({b}, {sk})")
+        if contiguous_segments and not any(
+                isinstance(s, jax.core.Tracer) for s in (q_seg, kv_seg)):
+            # block skipping is only sound for non-decreasing ids; with
+            # concrete ids enforce it here (traced ids: the caller owns the
+            # guarantee, like the reference's static bucket dispatch)
+            import numpy as _np
+
+            for name, ids in (("q", q_seg), ("kv", kv_seg)):
+                a = _np.asarray(ids)
+                if (_np.diff(a, axis=-1) < 0).any():
+                    raise ValueError(
+                        f"{name} segment ids are not non-decreasing; pass "
+                        "contiguous_segments=False for non-packed layouts "
+                        "(mask-only, no block skipping)")
+        # the lane-replicated kernel layout needs 128-aligned k blocks
+        blk_k = _pick_block(sk, block_k, mult=_NUM_LANES)
+        if blk_k % _NUM_LANES or sk % blk_k:
+            use = "xla"
+    if use == "xla":
+        return mha_reference(q, k, v, bias, causal=causal, scale=scale,
+                             segment_ids=segment_ids, pad_id=pad_id)
     if bias is not None:
         if bias.ndim != 4:
             raise ValueError(f"bias must be rank-4 broadcastable, got shape {bias.shape}")
@@ -559,4 +871,8 @@ def flash_attention(
             raise ValueError(f"bias shape {bias.shape} not broadcastable to "
                              f"({b}, {h}, {sq}, {sk})")
         bias = jnp.broadcast_to(bias, (bb, bh, sq, sk))
-    return _flash(q, k, v, bias, scale, bool(causal), blk_q, blk_k)
+    q_seg, kv_seg = segment_ids if segment_ids is not None else (None, None)
+    return _flash(q, k, v, bias, q_seg, kv_seg, scale, bool(causal),
+                  blk_q, blk_k,
+                  None if pad_id is None else int(pad_id),
+                  bool(contiguous_segments))

@@ -12,16 +12,22 @@ facilities:
    nvprof database.
 3. per-kernel FLOP/byte analysis (``prof/``, 26 op-category files) →
    :func:`cost_analysis` reads XLA's own compiled-program cost model
-   (flops/bytes per executable), and :func:`primitive_counts` gives the
-   per-op breakdown from the jaxpr. :func:`profile_fn` times a jitted fn
-   and reports achieved FLOP/s and bytes/s against those analytic counts.
+   (flops/bytes per executable); :func:`primitive_counts` gives the
+   per-op breakdown from the jaxpr; :func:`per_scope_costs` /
+   :func:`report` attribute FLOPs/bytes to ``named_scope`` stacks — the
+   per-op table the reference's prof stage prints (prof/output.py), with
+   a per-primitive handler table standing in for its 26 op-family files.
+   :func:`profile_fn` times a jitted fn and reports achieved FLOP/s and
+   bytes/s against those analytic counts.
 """
 
 from apex_tpu.pyprof.prof import (  # noqa: F401
     annotate,
     cost_analysis,
+    per_scope_costs,
     primitive_counts,
     profile_fn,
+    report,
     scope,
     trace,
 )

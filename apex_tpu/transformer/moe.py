@@ -201,13 +201,14 @@ class MoEMLP:
 
     def apply(self, params: Params, h: jax.Array) -> Tuple[jax.Array, Dict]:
         """``(…, d) → (…, d)`` plus aux losses — all experts local."""
-        shape = h.shape
-        h2d = h.reshape(-1, shape[-1])
-        dispatch, combine, stats = self._route(params, h2d)
-        xs = jnp.einsum("nec,nd->ecd", dispatch.astype(h2d.dtype), h2d)
-        ys = self._experts(params, xs)
-        out = jnp.einsum("nec,ecd->nd", combine.astype(h2d.dtype), ys)
-        return out.reshape(shape), self._aux_losses(stats)
+        with jax.named_scope("moe"):
+            shape = h.shape
+            h2d = h.reshape(-1, shape[-1])
+            dispatch, combine, stats = self._route(params, h2d)
+            xs = jnp.einsum("nec,nd->ecd", dispatch.astype(h2d.dtype), h2d)
+            ys = self._experts(params, xs)
+            out = jnp.einsum("nec,ecd->nd", combine.astype(h2d.dtype), ys)
+            return out.reshape(shape), self._aux_losses(stats)
 
     # -- expert-parallel forward --------------------------------------------
 

@@ -1,4 +1,7 @@
-"""Headline benchmark: GPT-2 345M mixed-precision training step on one chip.
+"""Headline benchmark: GPT-2 345M mixed-precision training step on one chip,
+plus the two non-GPT BASELINE configs (ResNet-50 O2+FusedSGD imgs/sec,
+BERT-large FusedLAMB tokens/sec) and an on-chip Pallas-kernel numerics
+selftest.
 
 Measures the framework's core promise — the reference's amp-O2 + fused-kernel
 recipe (BASELINE.md targets 3/4: fused step vs unfused eager) — as tokens/sec
@@ -9,9 +12,19 @@ on GPT-2 345M, bf16 O2 policy with Pallas flash attention and fused LN.
 build" way the reference warns is slower (README.md:134-139): fp32 O0, unfused
 XLA attention/LN, plain optax Adam.
 
+Measurement discipline (PERF_NOTES.md): every throughput number is the
+MEDIAN over >=3 timed windows on the same compiled program, with min/max
+spread recorded, so round-over-round deltas are attributable to code rather
+than co-tenant noise on the shared chip. ``vs_baseline`` is a ratio of
+same-session medians.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — plus
-"effective_batch" when OOM retries shrank a config's batch (the ratio is
-then re-measured at the common batch so vs_baseline stays apples-to-apples).
+"spread", "resnet50_o2_imgs_per_sec", "bert_large_lamb_tokens_per_sec",
+"fused_opt_step_vs_eager", and a "selftest" block of per-kernel max-error
+measurements (Pallas vs XLA fallback, fwd AND bwd, compiled on this chip).
+"effective_batch" appears when OOM retries shrank a config's batch (the
+ratio is then re-measured at the common batch so vs_baseline stays
+apples-to-apples).
 """
 
 from __future__ import annotations
@@ -29,6 +42,57 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import jax.numpy as jnp
+import numpy as np
+
+WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
+
+
+def _stats(rates):
+    """Median/min/max over timed windows (rounded for the JSON line)."""
+    s = sorted(rates)
+    n = len(s)
+    med = s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+    return {
+        "median": round(med, 1),
+        "min": round(s[0], 1),
+        "max": round(s[-1], 1),
+        "windows": n,
+    }
+
+
+def _is_oom(e: Exception) -> bool:
+    return "RESOURCE_EXHAUSTED" in str(e)
+
+
+def _timed_windows(advance, get_loss, *, steps, windows, per_window_units):
+    """The shared window-timing protocol: warmup happened already (caller
+    ran one step/chunk and fetched); each window runs ``advance()``
+    ``steps`` times, then stops the clock on a device→host fetch of the
+    loss (whose dependency chain covers every step — tunnel discipline,
+    PERF_NOTES.md). Returns per-window rates in ``per_window_units/s``."""
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            advance()
+        loss_val = float(get_loss())
+        dt = time.perf_counter() - t0
+        assert jnp.isfinite(loss_val), "non-finite loss in bench"
+        rates.append(per_window_units / dt)
+    return rates
+
+
+def _oom_halving(run, batch, *, min_batch, label):
+    """Run ``run(batch)``, halving the batch on RESOURCE_EXHAUSTED — the
+    shared co-tenant degradation ladder tail."""
+    while True:
+        try:
+            return run(batch)
+        except Exception as e:  # noqa: BLE001 - jaxlib error types vary
+            if not _is_oom(e) or batch <= min_batch:
+                raise
+            print(f"{label}: OOM at batch {batch}", file=sys.stderr)
+            batch //= 2
 
 
 def build(policy_level: str, impl: str, remat_policy=None):
@@ -75,9 +139,11 @@ def build(policy_level: str, impl: str, remat_policy=None):
     return step, params, opt_state
 
 
-def measure(step, params, opt_state, batch, seq, steps=10, scan_chunk=4) -> float:
-    """Time ``steps`` train steps, dispatched as scanned chunks of
-    ``scan_chunk`` steps per program when possible.
+def measure(step, params, opt_state, batch, seq, steps=10, scan_chunk=4,
+            windows=WINDOWS):
+    """Time ``windows`` windows of ``steps`` train steps each, dispatched as
+    scanned chunks of ``scan_chunk`` steps per program when possible;
+    returns the per-window tokens/sec list.
 
     The scan matters twice over through the axon tunnel: it amortizes
     per-dispatch overhead, and — since the tunnel backend rejects buffer
@@ -115,23 +181,22 @@ def measure(step, params, opt_state, batch, seq, steps=10, scan_chunk=4) -> floa
     # round the requested step count up to whole chunks (never time fewer
     # steps than asked); normalization below uses the actual count run
     n_chunks = max(1, -(-steps // scan_chunk))
+    state = [params, opt_state, None]
+
+    def advance():
+        state[:] = run_chunk(state[0], state[1], tokens, targets)
+
     # warmup / compile. Through remote-device tunnels (axon),
     # block_until_ready can ack dispatch rather than execution, so force a
     # device->host transfer of a value that depends on the whole chain.
-    params, opt_state, loss = run_chunk(params, opt_state, tokens, targets)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        params, opt_state, loss = run_chunk(params, opt_state, tokens, targets)
-    # the final loss depends on every prior step's params: fetching it to the
-    # host forces full execution before the clock stops.
-    loss_val = float(loss)
-    dt = (time.perf_counter() - t0) / (n_chunks * scan_chunk)
-    assert jnp.isfinite(loss_val), "non-finite loss in bench"
-    return batch * seq / dt
+    advance()
+    float(state[2])
+    return _timed_windows(
+        advance, lambda: state[2], steps=n_chunks, windows=windows,
+        per_window_units=batch * seq * n_chunks * scan_chunk)
 
 
-def measure_resilient(level, impl, batch, seq, steps):
+def measure_resilient(level, impl, batch, seq, steps, windows=WINDOWS):
     """The chip is shared: co-tenant HBM pressure can OOM a config that
     normally fits. Degrade gracefully — selective remat → full remat,
     scanned dispatch → per-step dispatch, then halve the batch (tokens/s is
@@ -145,11 +210,11 @@ def measure_resilient(level, impl, batch, seq, steps):
     while True:
         for remat_policy, scan_chunk in ladder:
             try:
-                tps = measure(*build(level, impl, remat_policy), batch, seq,
-                              steps, scan_chunk=scan_chunk)
-                return tps, batch
+                rates = measure(*build(level, impl, remat_policy), batch, seq,
+                                steps, scan_chunk=scan_chunk, windows=windows)
+                return rates, batch
             except Exception as e:  # noqa: BLE001 - jaxlib error types vary
-                if "RESOURCE_EXHAUSTED" not in str(e):
+                if not _is_oom(e):
                     raise
                 last_oom = e
                 print(f"{level}: OOM at remat_policy={remat_policy} "
@@ -160,37 +225,294 @@ def measure_resilient(level, impl, batch, seq, steps):
         batch //= 2
 
 
+# ---------------------------------------------------------------------------
+# ResNet-50 O2 + FusedSGD (BASELINE.md configs 1-2: the named headline
+# metric "ResNet-50 imgs/sec/chip (amp O2-equivalent)"). Single chip, so
+# SyncBatchNorm's cross-shard merge is the identity; the conv/NHWC/BN path
+# is what is being measured. Reference recipe:
+# examples/imagenet/main_amp.py:281+ (ours: examples/imagenet/main_amp.py).
+# ---------------------------------------------------------------------------
+
+
+def bench_resnet50(batch=None, steps=10, windows=WINDOWS):
+    from apex_tpu import amp
+    from apex_tpu.models.resnet import ResNet50
+    from apex_tpu.ops.xentropy import softmax_cross_entropy
+    from apex_tpu.optimizers import FusedSGD
+
+    batch = batch or int(os.environ.get("BENCH_RESNET_BATCH", "64"))
+    policy = amp.get_policy("O2")
+    model = ResNet50(num_classes=1000, dtype=policy.op_dtype("conv"))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4, nesterov=True),
+        policy)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3), jnp.float32))
+    params = amp.cast_params(variables["params"], policy)
+    batch_stats = variables["batch_stats"]
+    opt_state = mp_opt.init(params)
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, images, labels):
+        def scaled_loss(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                mutable=["batch_stats"])
+            loss = jnp.mean(softmax_cross_entropy(logits, labels))
+            return mp_opt.scale_loss(loss, opt_state), mutated["batch_stats"]
+
+        (scaled, new_stats), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True)(params)
+        new_params, new_opt, metrics = mp_opt.apply_gradients(
+            opt_state, params, grads)
+        return (new_params, new_stats, new_opt,
+                scaled / opt_state.scaler.loss_scale)
+
+    def run(batch):
+        images = jax.random.normal(jax.random.PRNGKey(1),
+                                   (batch, 224, 224, 3), jnp.float32)
+        labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
+        state = [params, batch_stats, opt_state, None]
+
+        def advance():
+            state[:] = step(state[0], state[1], state[2], images, labels)
+
+        advance()
+        float(state[3])  # compile + execute barrier
+        rates = _timed_windows(advance, lambda: state[3], steps=steps,
+                               windows=windows,
+                               per_window_units=batch * steps)
+        return dict(_stats(rates), batch=batch)
+
+    return _oom_halving(run, batch, min_batch=4, label="resnet50")
+
+
+# ---------------------------------------------------------------------------
+# BERT-large-ish + FusedLAMB (BASELINE.md config 3: BERT pretraining with
+# FusedLAMB + FusedLayerNorm). Reference recipe: the L0 BERT minimal test
+# (run_bert_minimal_test.py) at bert-large shapes.
+# ---------------------------------------------------------------------------
+
+
+def bench_bert_lamb(batch=None, steps=10, windows=WINDOWS):
+    from apex_tpu import amp
+    from apex_tpu.models import BertConfig, BertModel
+    from apex_tpu.optimizers import FusedLAMB
+
+    batch = batch or int(os.environ.get("BENCH_BERT_BATCH", "8"))
+    seq = 512
+    cfg = BertConfig(
+        vocab_size=30592, hidden_size=1024, num_layers=24,
+        num_attention_heads=16, max_seq_len=seq, hidden_dropout=0.0,
+        axis=None, compute_dtype=jnp.bfloat16, remat=True)
+    model = BertModel(cfg)
+    policy = amp.get_policy("O2")
+    mp_opt = amp.MixedPrecisionOptimizer(FusedLAMB(lr=1e-3), policy)
+    params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    opt_state = mp_opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, toks, lmask, labels, nsp):
+        def scaled_loss(p):
+            return mp_opt.scale_loss(
+                model.loss(p, toks, None, lmask, labels, nsp), opt_state)
+
+        loss_s, grads = jax.value_and_grad(scaled_loss)(params)
+        new_params, new_state, _ = mp_opt.apply_gradients(
+            opt_state, params, grads)
+        return new_params, new_state, loss_s / opt_state.scaler.loss_scale
+
+    def run(batch):
+        ks = jax.random.split(jax.random.PRNGKey(1), 4)
+        toks = jax.random.randint(ks[0], (batch, seq), 0, cfg.vocab_size)
+        lmask = (jax.random.uniform(ks[1], (batch, seq)) < 0.15).astype(jnp.int32)
+        labels = jax.random.randint(ks[2], (batch, seq), 0, cfg.vocab_size)
+        nsp = jax.random.randint(ks[3], (batch,), 0, 2)
+        state = [params, opt_state, None]
+
+        def advance():
+            state[:] = step(state[0], state[1], toks, lmask, labels, nsp)
+
+        advance()
+        float(state[2])
+        rates = _timed_windows(advance, lambda: state[2], steps=steps,
+                               windows=windows,
+                               per_window_units=batch * seq * steps)
+        return dict(_stats(rates), batch=batch)
+
+    return _oom_halving(run, batch, min_batch=1, label="bert")
+
+
+# ---------------------------------------------------------------------------
+# On-chip kernel numerics selftest: the COMPILED Pallas kernels (TPU tiling,
+# MXU accumulation) vs their XLA fallbacks, fwd AND bwd — the coverage
+# interpret-mode CPU tests cannot give (reference pattern: the
+# elementwise-tolerance tests of tests/L0/run_fused_layer_norm/).
+# ---------------------------------------------------------------------------
+
+
+def _max_errs(a, b):
+    """(max abs error, scale-normalized error): the normalized form divides
+    by the reference tensor's max magnitude, the right yardstick for bf16
+    tensors whose values span decades (pointwise relative error explodes on
+    near-zero entries; plain abs error penalizes large-magnitude grads)."""
+    a = np.asarray(jax.device_get(a), np.float64)
+    b = np.asarray(jax.device_get(b), np.float64)
+    if not a.size:
+        return 0.0, 0.0
+    abs_err = float(np.max(np.abs(a - b)))
+    scale = max(float(np.max(np.abs(b))), 1e-6)
+    return abs_err, abs_err / scale
+
+
+def _compare(fn_pallas, fn_xla, args, tol_norm, grad_argnums=None):
+    """fwd + bwd max abs / scale-normalized error between two impls of the
+    same math; ``ok`` gates on the normalized error."""
+    fwd_p = jax.jit(fn_pallas)(*args)
+    fwd_x = jax.jit(fn_xla)(*args)
+    abs_err, norm_err = _max_errs(fwd_p, fwd_x)
+    entry = {"fwd_max_abs_err": round(abs_err, 6),
+             "fwd_norm_err": round(norm_err, 6)}
+    if grad_argnums is not None:
+        # random (fixed-key) cotangent: grads of sum(out * w)
+        w = jax.random.normal(jax.random.PRNGKey(7), fwd_p.shape,
+                              jnp.float32).astype(fwd_p.dtype)
+
+        def loss(fn):
+            return lambda *a: jnp.sum(fn(*a).astype(jnp.float32)
+                                      * w.astype(jnp.float32))
+
+        g_p = jax.jit(jax.grad(loss(fn_pallas), argnums=grad_argnums))(*args)
+        g_x = jax.jit(jax.grad(loss(fn_xla), argnums=grad_argnums))(*args)
+        g_abs = g_norm = 0.0
+        for a, b in zip(jax.tree.leaves(g_p), jax.tree.leaves(g_x)):
+            ae, ne = _max_errs(a, b)
+            g_abs, g_norm = max(g_abs, ae), max(g_norm, ne)
+        entry["bwd_max_abs_err"] = round(g_abs, 6)
+        entry["bwd_norm_err"] = round(g_norm, 6)
+    entry["tol_norm"] = tol_norm
+    worst = max(v for k, v in entry.items() if k.endswith("norm_err"))
+    entry["ok"] = bool(worst <= tol_norm)
+    return entry
+
+
+def selftest():
+    """Per-kernel compiled-vs-fallback max errors on THIS backend."""
+    from functools import partial
+
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+    from apex_tpu.ops.lm_head_loss import (
+        lm_head_cross_entropy,
+        lm_head_cross_entropy_reference,
+    )
+    from apex_tpu.ops.softmax import scaled_masked_softmax
+    from apex_tpu.ops.xentropy import softmax_cross_entropy
+
+    results = {"platform": jax.default_backend()}
+    key = jax.random.PRNGKey(0)
+
+    # flash attention: bf16 production dtype, causal (the GPT path)
+    b, h, s, d = 2, 8, 1024, 64
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.bfloat16)
+    results["flash_attention"] = _compare(
+        partial(flash_attention, causal=True, impl="pallas"),
+        partial(flash_attention, causal=True, impl="xla"),
+        (q, k, v), tol_norm=2e-2, grad_argnums=(0, 1, 2))
+
+    # fused LN / RMSNorm: bf16 x, fp32 gamma/beta (the MixedFused contract)
+    x = jax.random.normal(key, (512, 1024), jnp.bfloat16)
+    wln = 1.0 + 0.1 * jax.random.normal(kq, (1024,), jnp.float32)
+    bln = 0.1 * jax.random.normal(kk, (1024,), jnp.float32)
+    results["layer_norm"] = _compare(
+        partial(layer_norm, impl="pallas"), partial(layer_norm, impl="xla"),
+        (x, wln, bln), tol_norm=2e-2, grad_argnums=(0, 1, 2))
+    results["rms_norm"] = _compare(
+        partial(rms_norm, impl="pallas"), partial(rms_norm, impl="xla"),
+        (x, wln), tol_norm=2e-2, grad_argnums=(0, 1))
+
+    # scaled-mask softmax (causal, the Megatron kernel pair)
+    logits = jax.random.normal(key, (4, 8, 256, 256), jnp.bfloat16)
+    results["scaled_masked_softmax"] = _compare(
+        partial(scaled_masked_softmax, scale=0.125, causal=True,
+                impl="pallas"),
+        partial(scaled_masked_softmax, scale=0.125, causal=True, impl="xla"),
+        (logits,), tol_norm=2e-2, grad_argnums=(0,))
+
+    # fused label-smoothing CE (fp32 logits like the vocab head)
+    vlog = jax.random.normal(key, (1024, 8192), jnp.float32)
+    labels = jax.random.randint(kq, (1024,), 0, 8192)
+    results["xentropy"] = _compare(
+        partial(softmax_cross_entropy, smoothing=0.1, impl="pallas"),
+        partial(softmax_cross_entropy, smoothing=0.1, impl="xla"),
+        (vlog, labels), tol_norm=1e-3, grad_argnums=(0,))
+
+    # chunked LM-head CE vs the unchunked reference (both XLA; the chunk
+    # scan's accumulation order is what is under test)
+    hs = jax.random.normal(key, (4, 256, 512), jnp.bfloat16)
+    wte = jax.random.normal(kk, (8192, 512), jnp.bfloat16)
+    tgt = jax.random.randint(kv, (4, 256), 0, 8192)
+    results["lm_head_loss"] = _compare(
+        lambda hh, ww: lm_head_cross_entropy(hh, ww, tgt, num_chunks=8),
+        lambda hh, ww: lm_head_cross_entropy_reference(hh, ww, tgt),
+        (hs, wte), tol_norm=2e-2, grad_argnums=(0, 1))
+
+    results["all_ok"] = all(
+        v.get("ok", True) for v in results.values() if isinstance(v, dict))
+    return results
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = 1024
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     print(f"platform: {jax.default_backend()}", file=sys.stderr)
 
-    fused_tps, fused_batch = measure_resilient("O2", "auto", batch, seq, steps)
-    print(f"O2+fused: {fused_tps:.0f} tokens/s (batch {fused_batch})", file=sys.stderr)
-    base_tps, base_batch = measure_resilient("O0", "xla", batch, seq, steps)
-    print(f"O0 fp32 unfused: {base_tps:.0f} tokens/s (batch {base_batch})", file=sys.stderr)
+    fused_rates, fused_batch = measure_resilient("O2", "auto", batch, seq, steps)
+    fused = _stats(fused_rates)
+    print(f"O2+fused: {fused} (batch {fused_batch})", file=sys.stderr)
+    base_rates, base_batch = measure_resilient("O0", "xla", batch, seq, steps)
+    base = _stats(base_rates)
+    print(f"O0 fp32 unfused: {base} (batch {base_batch})", file=sys.stderr)
 
-    ratio_fused, ratio_base = fused_tps, base_tps
+    ratio_fused, ratio_base = fused["median"], base["median"]
     if fused_batch != base_batch:
         # batch size changes utilization: re-measure the larger-batch config
         # at the common (smaller) batch so the ratio compares like with like
         common = min(fused_batch, base_batch)
         if fused_batch > common:
-            ratio_fused, _ = measure_resilient("O2", "auto", common, seq, steps)
+            r, _ = measure_resilient("O2", "auto", common, seq, steps)
+            ratio_fused = _stats(r)["median"]
         else:
-            ratio_base, _ = measure_resilient("O0", "xla", common, seq, steps)
+            r, _ = measure_resilient("O0", "xla", common, seq, steps)
+            ratio_base = _stats(r)["median"]
         print(f"ratio re-measured at common batch {common}", file=sys.stderr)
 
     result = {
         "metric": "gpt2_345m_o2_train_tokens_per_sec",
-        "value": round(fused_tps, 1),
+        "value": fused["median"],
         "unit": "tokens/s",
         "vs_baseline": round(ratio_fused / ratio_base, 3),
+        # same-session medians + spread: the noise band that makes
+        # round-over-round deltas attributable (VERDICT r2 weak #4)
+        "spread": {"o2": fused, "o0": base},
     }
     if fused_batch != batch or base_batch != batch:
         # record the actually-measured config when OOM retries shrank it
         result["effective_batch"] = {"o2": fused_batch, "o0": base_batch}
+
+    # BASELINE.md configs 1-3, measured on the same chip/session
+    # (VERDICT r2 weak #1: the conv/BN and LAMB paths need TPU numbers)
+    for key, fn in (("resnet50_o2_imgs_per_sec", bench_resnet50),
+                    ("bert_large_lamb_tokens_per_sec", bench_bert_lamb)):
+        try:
+            result[key] = fn()
+            print(f"{key}: {result[key]}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - never lose the headline metric
+            print(f"{key} failed: {e}", file=sys.stderr)
 
     # BASELINE.md target #3, measured directly: fused whole-tree optimizer
     # step vs unfused per-leaf eager Adam (benchmarks/optimizer_step.py).
@@ -203,8 +525,19 @@ def main():
     except Exception as e:  # noqa: BLE001 - never lose the headline metric
         print(f"optimizer-step microbench failed: {e}", file=sys.stderr)
 
+    # compiled-kernel numerics on this chip (VERDICT r2 weak #2)
+    try:
+        result["selftest"] = selftest()
+        print(f"selftest all_ok={result['selftest']['all_ok']}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"selftest failed: {e}", file=sys.stderr)
+        result["selftest"] = {"error": str(e)[:200]}
+
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if "--selftest" in sys.argv:
+        print(json.dumps({"selftest": selftest()}))
+    else:
+        main()

@@ -143,3 +143,45 @@ def test_bert_stage_decomposition_matches_apply():
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(full_bin), np.asarray(staged_bin),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+def test_bert_context_parallel_matches_serial(sp_impl):
+    """Sequence-parallel BERT (bidirectional ring/Ulysses via the shared
+    TransformerBase._attend): loss parity serial vs cp=2. No padding mask
+    (the ring takes no bias) and no NSP head (h[:, 0] is shard-local under
+    sequence sharding); all-ones loss_mask keeps per-shard means equal to
+    the global masked mean."""
+    cfg = dict(TINY, axis=None, add_binary_head=False)
+    serial = BertModel(BertConfig(**cfg))
+    par = BertModel(BertConfig(
+        context_axis=mesh_lib.AXIS_CONTEXT,
+        sequence_parallel_impl=sp_impl, **cfg))
+    params = serial.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+    lmask = jnp.ones((2, 16), jnp.int32)
+
+    ref_loss, ref_grads = jax.value_and_grad(serial.loss)(
+        params, toks, None, lmask, labels)
+
+    mesh = mesh_lib.make_virtual_mesh(2, context_parallel_size=2)
+    try:
+        def sp_step(p, toks, lmask, labels):
+            loss, g = jax.value_and_grad(par.loss)(p, toks, None, lmask, labels)
+            return (jax.lax.pmean(loss, mesh_lib.AXIS_CONTEXT),
+                    jax.lax.pmean(g, mesh_lib.AXIS_CONTEXT))
+
+        seq_spec = P(None, mesh_lib.AXIS_CONTEXT)
+        loss, grads = jax.jit(jax.shard_map(
+            sp_step, mesh=mesh,
+            in_specs=(P(), seq_spec, seq_spec, seq_spec),
+            out_specs=(P(), P()),
+            check_vma=False))(params, toks, lmask, labels)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+            jax.device_get(grads), jax.device_get(ref_grads))
+    finally:
+        mesh_lib.destroy_model_parallel()
