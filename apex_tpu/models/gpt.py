@@ -119,6 +119,7 @@ class GPTModel(TransformerBase):
                 c.hidden_size, c.ffn, num_experts=c.moe_num_experts,
                 top_k=c.moe_top_k, capacity_factor=c.moe_capacity_factor,
                 expert_axis=c.moe_expert_axis,
+                tp_axis=c.axis,  # expert FFNs ride the model axis (EP x TP)
                 params_dtype=c.params_dtype,
                 init_method=tp.scaled_normal(c.init_method_std),
             )

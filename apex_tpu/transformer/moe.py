@@ -66,6 +66,11 @@ class MoEMLP:
       top_k: experts per token (1 = Switch, 2 = GShard default).
       capacity_factor: slack over the perfectly-balanced C.
       expert_axis: mesh axis name the expert dim shards over (``specs``).
+      tp_axis: mesh axis name each expert's FFN shards over — Megatron
+        column/row parallelism INSIDE every expert (fc1 splits the ffn
+        dim, fc2 consumes the local shard; one identity-backward psum per
+        layer, exactly the Row/ColumnParallelLinear pair), composing
+        EP × TP for GPT-3-scale ffn widths.
       params_dtype: parameter dtype (router stays fp32 — routing logits
         are precision-sensitive, like vocab logits).
     """
@@ -78,6 +83,7 @@ class MoEMLP:
         top_k: int = 2,
         capacity_factor: float = 1.25,
         expert_axis: Optional[str] = None,
+        tp_axis: Optional[str] = None,
         params_dtype: Any = jnp.float32,
         init_method=None,
     ):
@@ -89,6 +95,7 @@ class MoEMLP:
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.expert_axis = expert_axis
+        self.tp_axis = tp_axis
         self.params_dtype = params_dtype
         self.init_method = init_method or tp.scaled_normal(0.02)
 
@@ -112,11 +119,14 @@ class MoEMLP:
         }
 
     def specs(self) -> Params:
-        ax = self.expert_axis
+        ax, tx = self.expert_axis, self.tp_axis
         return {
             "router": {"kernel": P()},
-            "fc1": {"kernel": P(ax, None, None), "bias": P(ax, None)},
-            "fc2": {"kernel": P(ax, None, None), "bias": P(ax, None)},
+            # fc1 column-parallel (split ffn out-dim), fc2 row-parallel
+            # (split ffn in-dim); fc2 bias replicated over tp (added once,
+            # after the reduction)
+            "fc1": {"kernel": P(ax, None, tx), "bias": P(ax, tx)},
+            "fc2": {"kernel": P(ax, tx, None), "bias": P(ax, None)},
         }
 
     # -- routing ------------------------------------------------------------
@@ -188,13 +198,21 @@ class MoEMLP:
 
     def _experts(self, params: Params, x: jax.Array) -> jax.Array:
         """(E_local, C', d) → (E_local, C', d): per-expert FFN, batched as
-        one einsum pair so all experts' GEMMs fuse into two MXU calls."""
+        one einsum pair so all experts' GEMMs fuse into two MXU calls.
+
+        With ``tp_axis`` the ffn dim is sharded (fc1 column-parallel, fc2
+        row-parallel): the fc2 einsum yields partial sums, reduced by one
+        identity-backward psum per call — the Megatron Row/Column pair
+        inside every expert."""
         dt = x.dtype
         h = jnp.einsum("ecd,edf->ecf", x,
                        params["fc1"]["kernel"].astype(dt))
         h = jax.nn.gelu(h + params["fc1"]["bias"].astype(dt)[:, None, :])
         out = jnp.einsum("ecf,efd->ecd", h,
                          params["fc2"]["kernel"].astype(dt))
+        if self.tp_axis is not None:
+            out = tp.reduce_from_tensor_model_parallel_region(
+                out, self.tp_axis)
         return out + params["fc2"]["bias"].astype(dt)[:, None, :]
 
     # -- serial forward -----------------------------------------------------

@@ -113,6 +113,14 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
         shard = lambda a: jax.device_put(a, NamedSharding(mesh, data_spec))
         toks, tgts = shard(toks), shard(tgts)
 
+        # compile-time collective-overlap evidence: real multi-chip runs are
+        # impossible in this environment, so multi-chip readiness is argued
+        # from the compiled HLO — async collective pairs (*-start/*-done
+        # with instructions scheduled between them) are what lets XLA hide
+        # the pipeline ring / TP allreduces behind compute on ICI.
+        overlap = _overlap_evidence(
+            train_step.lower(params, opt_state, toks, tgts).compile())
+
         params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
         float(loss)  # compile + execute barrier
         t0 = time.perf_counter()
@@ -125,9 +133,39 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
             "avg_iteration_time_s": round(dt, 4),
             "tokens_per_sec": round(batch * seq / dt, 1),
             "loss": round(loss_val, 4),
+            "overlap": overlap,
         }
     finally:
         mesh_lib.destroy_model_parallel()
+
+
+def _overlap_evidence(compiled):
+    """Count async collective pairs in the compiled HLO and pull the cost
+    model's bytes — per-config artifacts (not prose) that the sharded step
+    compiles to overlappable collectives (reference ethos:
+    gpt_scaling_test.py:49-70 measure-and-record)."""
+    import re
+
+    hlo = compiled.as_text()
+    counts = {}
+    for op in ("collective-permute", "all-reduce", "all-gather",
+               "reduce-scatter", "all-to-all"):
+        # instruction definitions: "<shape> op(.N)(operands" — operand
+        # references carry a % prefix, so a space before the op name means
+        # a definition site
+        starts = len(re.findall(rf" {op}-start(\.\d+)?\(", hlo))
+        total = len(re.findall(rf" {op}(\.\d+)?\(", hlo)) + starts
+        if starts or total:
+            counts[op.replace("-", "_")] = {"total": total, "async_pairs": starts}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        counts["bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
+        counts["flops"] = float(cost.get("flops", 0.0))
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        pass
+    return counts
 
 
 def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
