@@ -25,6 +25,16 @@ measurements (Pallas vs XLA fallback, fwd AND bwd, compiled on this chip).
 "effective_batch" appears when OOM retries shrank a config's batch (the
 ratio is then re-measured at the common batch so vs_baseline stays
 apples-to-apples).
+
+Crash discipline: the GPT headline (and, if it cannot fit, the degraded
+rung under its own "gpt_degraded" key — never substituted for the
+headline) each run in a FRESH SUBPROCESS that owns the chip alone, before
+the parent touches the backend; the parent then gathers the
+small-footprint evidence (selftest, optimizer microbench, ResNet floor-4,
+BERT, pyprof scope seconds) with every stage individually wrapped. Stage
+failures land in "errors"; the JSON line always prints and the process
+always exits 0. The headline's O2/O0 windows are interleaved in time so
+vs_baseline is robust to co-tenant drift ("interleaved": true in spread).
 """
 
 from __future__ import annotations
@@ -61,7 +71,14 @@ def _stats(rates):
 
 
 def _is_oom(e: Exception) -> bool:
-    return "RESOURCE_EXHAUSTED" in str(e)
+    # walk the cause chain: the ladder re-raises OOMs as RuntimeError with
+    # the jaxlib RESOURCE_EXHAUSTED as __cause__
+    seen = 0
+    while e is not None and seen < 8:
+        if "RESOURCE_EXHAUSTED" in str(e) or "OOM even at batch" in str(e):
+            return True
+        e, seen = e.__cause__, seen + 1
+    return False
 
 
 def _timed_windows(advance, get_loss, *, steps, windows, per_window_units):
@@ -95,7 +112,8 @@ def _oom_halving(run, batch, *, min_batch, label):
             batch //= 2
 
 
-def build(policy_level: str, impl: str, remat_policy=None):
+def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
+          layers=None):
     import optax
 
     from apex_tpu import amp
@@ -105,8 +123,8 @@ def build(policy_level: str, impl: str, remat_policy=None):
     fused = policy_level == "O2"
     cfg = GPTConfig(
         vocab_size=50304,
-        hidden_size=int(os.environ.get("BENCH_HIDDEN", "1024")),
-        num_layers=int(os.environ.get("BENCH_LAYERS", "24")),
+        hidden_size=hidden or int(os.environ.get("BENCH_HIDDEN", "1024")),
+        num_layers=layers or int(os.environ.get("BENCH_LAYERS", "24")),
         num_attention_heads=16,
         max_seq_len=1024,
         hidden_dropout=0.0,
@@ -139,19 +157,17 @@ def build(policy_level: str, impl: str, remat_policy=None):
     return step, params, opt_state
 
 
-def measure(step, params, opt_state, batch, seq, steps=10, scan_chunk=4,
-            windows=WINDOWS):
-    """Time ``windows`` windows of ``steps`` train steps each, dispatched as
-    scanned chunks of ``scan_chunk`` steps per program when possible;
-    returns the per-window tokens/sec list.
+def _prepare(step, params, opt_state, batch, seq, steps=10, scan_chunk=4):
+    """Build + warm up (compile and run one chunk) a GPT train-step
+    measurement; returns ``(advance, get_loss, n_chunks, per_window_units,
+    state)`` so callers can run windows themselves — the interleaved
+    headline alternates windows between two prepared configs.
 
     The scan matters twice over through the axon tunnel: it amortizes
     per-dispatch overhead, and — since the tunnel backend rejects buffer
     donation — it is the only way the params/optimizer state update
     in-place (the scan carry lives inside one program) instead of being
     rewritten to fresh buffers every step. ~5% end-to-end (PERF_NOTES.md).
-    Falls back to single-step dispatch (scan_chunk=1) if the scanned
-    program does not fit.
     """
     from jax import lax
 
@@ -191,38 +207,180 @@ def measure(step, params, opt_state, batch, seq, steps=10, scan_chunk=4,
     # device->host transfer of a value that depends on the whole chain.
     advance()
     float(state[2])
-    return _timed_windows(
-        advance, lambda: state[2], steps=n_chunks, windows=windows,
-        per_window_units=batch * seq * n_chunks * scan_chunk)
+    return (advance, lambda: state[2], n_chunks,
+            batch * seq * n_chunks * scan_chunk, state)
 
 
-def measure_resilient(level, impl, batch, seq, steps, windows=WINDOWS):
-    """The chip is shared: co-tenant HBM pressure can OOM a config that
-    normally fits. Degrade gracefully — selective remat → full remat,
-    scanned dispatch → per-step dispatch, then halve the batch (tokens/s is
-    per-token normalized) — rather than lose the round's record."""
+_LADDERS = {
     # (remat_policy, scan_chunk) from fastest to most memory-frugal.
     # save_attn keeps the flash kernel outputs so backward skips the
     # attention recompute (~5% when HBM allows it).
-    ladder = ([("save_attn", 4), (None, 4), (None, 1)] if level == "O2"
-              else [(None, 4), (None, 1)])
-    last_oom = None
+    "O2": [("save_attn", 4), (None, 4), (None, 1)],
+    "O0": [(None, 4), (None, 1)],
+}
+
+
+def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
+                      hidden=None, layers=None, retries=1, retry_sleep=25):
+    """Ladder-degrading ``_prepare``: selective remat → full remat, scanned
+    dispatch → per-step dispatch, then halve the batch, until the config
+    compiles and warms up under today's co-tenant HBM pressure. When the
+    whole ladder OOMs, sleep and retry it once from the top — through the
+    tunnel, buffer frees land asynchronously and co-tenant spikes pass
+    within tens of seconds (both observed live in r4: a config that OOM'd
+    at batch 1 ran at 64k tok/s in the same process minutes later).
+    Returns ``(advance, get_loss, n_chunks, units, state, batch)``."""
+    import gc
+
+    batch0 = batch
+    attempt = 0
+    last_oom = ""
     while True:
-        for remat_policy, scan_chunk in ladder:
+        for remat_policy, scan_chunk in _LADDERS[level]:
             try:
-                rates = measure(*build(level, impl, remat_policy), batch, seq,
-                                steps, scan_chunk=scan_chunk, windows=windows)
-                return rates, batch
+                prep = _prepare(
+                    *build(level, impl, remat_policy, hidden, layers),
+                    batch, seq, steps, scan_chunk=scan_chunk)
+                return prep + (batch,)
             except Exception as e:  # noqa: BLE001 - jaxlib error types vary
                 if not _is_oom(e):
                     raise
-                last_oom = e
+                # keep only a STRING: retaining the exception object keeps
+                # its traceback frames — and with them the failed attempt's
+                # device buffers — alive into the next, smaller rung, which
+                # then OOMs against the ghost of this one
+                last_oom = str(e)[:500]
+                del e
+                gc.collect()
                 print(f"{level}: OOM at remat_policy={remat_policy} "
                       f"scan={scan_chunk}, batch {batch}", file=sys.stderr)
-        if batch <= 1:
-            # keep the jaxlib allocator diagnostics on the chained cause
-            raise RuntimeError(f"{level}: OOM even at batch 1") from last_oom
+        if batch <= min_batch:
+            if attempt < retries:
+                attempt += 1
+                print(f"{level}: ladder exhausted; sleeping {retry_sleep}s "
+                      f"(async tunnel frees / co-tenant spike), retry "
+                      f"{attempt}/{retries} from batch {batch0}",
+                      file=sys.stderr)
+                time.sleep(retry_sleep)
+                batch = batch0
+                continue
+            raise RuntimeError(
+                f"{level}: OOM even at batch {batch}; last: {last_oom}")
         batch //= 2
+
+
+def measure_resilient(level, impl, batch, seq, steps, windows=WINDOWS,
+                      hidden=None, layers=None):
+    """``prepare_resilient`` (build + warm up one config down the OOM
+    ladder) + timed windows, re-degrading if co-tenant pressure arrives
+    between warmup and the windows."""
+    import gc
+
+    while True:
+        advance, get_loss, n_chunks, units, _state, batch = prepare_resilient(
+            level, impl, batch, seq, steps, hidden=hidden, layers=layers)
+        try:
+            rates = _timed_windows(advance, get_loss, steps=n_chunks,
+                                   windows=windows, per_window_units=units)
+            return rates, batch
+        except Exception as e:  # noqa: BLE001
+            if not _is_oom(e) or batch <= 1:
+                raise
+            print(f"{level}: OOM during windows at batch {batch}",
+                  file=sys.stderr)
+            batch //= 2
+            # drop this attempt's program + buffers before re-preparing
+            del advance, get_loss, _state
+            gc.collect()
+
+
+def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
+    """O2-fused vs O0-fp32-unfused GPT train step, with the two configs'
+    timed windows INTERLEAVED (O2, O0, O2, O0, …) so ``vs_baseline`` is a
+    ratio of medians measured under the same minutes of co-tenant drift
+    (VERDICT r3 #8). Falls back to sequential measurement when both
+    programs cannot be resident in HBM together; the fallback is recorded
+    as ``"interleaved": false`` in the spread block.
+
+    Returns ``(value_stats, base_stats, common_batch, interleaved)``;
+    ``base_stats`` is None when the fp32 baseline cannot fit at all (the
+    O2 value is still reported — losing the ratio must not lose the
+    headline, VERDICT r3 ask #1)."""
+    prep2 = prepare_resilient("O2", "auto", batch, seq, steps,
+                              hidden=hidden, layers=layers)
+    b2 = prep2[-1]
+    # time the headline VALUE first, before any baseline attempt can churn
+    # HBM (observed: the O0-345M fp32 leg can be unplaceable for minutes
+    # while O2 bf16 runs fine)
+    solo2 = _stats(_timed_windows(prep2[0], prep2[1], steps=prep2[2],
+                                  windows=windows,
+                                  per_window_units=prep2[3]))
+    interleaved = True
+    prep0 = None
+    try:
+        # co-resident attempt: fail FAST (no sleep-retry) — laddering O0
+        # while the O2 program occupies HBM fights a doomed residency; the
+        # sequential fallback frees O2 first and ladders with retries
+        prep0 = prepare_resilient("O0", "xla", b2, seq, steps, min_batch=b2,
+                                  hidden=hidden, layers=layers, retries=0)
+    except Exception as e:  # noqa: BLE001
+        if not _is_oom(e):
+            raise
+        interleaved = False
+    if prep0 is None:
+        # Could not co-reside at O2's batch. Measure sequentially, re-doing
+        # whichever config sits at the larger batch until both were timed
+        # at the SAME batch (the ladder can halve during re-measurement).
+        import gc
+
+        del prep2
+        gc.collect()
+        try:
+            b = b2
+            while True:
+                rates0, b0 = measure_resilient("O0", "xla", b, seq, steps,
+                                               windows, hidden=hidden,
+                                               layers=layers)
+                rates2, b = measure_resilient("O2", "auto", b0, seq, steps,
+                                              windows, hidden=hidden,
+                                              layers=layers)
+                if b == b0:
+                    return _stats(rates2), _stats(rates0), b, False
+        except Exception as e:  # noqa: BLE001
+            if not _is_oom(e):
+                raise
+            print("headline: fp32 baseline unplaceable; reporting the O2 "
+                  "value without a ratio", file=sys.stderr)
+            return solo2, None, b2, False
+    # min_batch=b2 on the co-resident prepare means success implies the
+    # same batch; the unequal-batch case always goes through the
+    # sequential fallback above
+    assert prep0[-1] == b2, (prep0[-1], b2)
+    b0 = b2
+    adv2, loss2, n2, u2, _s2, _ = prep2
+    adv0, loss0, n0, u0, _s0, _ = prep0
+    rates2, rates0 = [], []
+    try:
+        for _ in range(windows):
+            rates2 += _timed_windows(adv2, loss2, steps=n2, windows=1,
+                                     per_window_units=u2)
+            rates0 += _timed_windows(adv0, loss0, steps=n0, windows=1,
+                                     per_window_units=u0)
+    except Exception as e:  # noqa: BLE001
+        if not _is_oom(e):
+            raise
+        if not (rates2 and rates0):
+            print("headline: OOM before any interleaved pair completed; "
+                  "reporting the solo O2 value without a ratio",
+                  file=sys.stderr)
+            return solo2, None, b2, False
+        # keep only COMPLETED pairs: an unpaired O2 window measured before
+        # the OOM spike would bias the ratio the interleave exists to guard
+        n = min(len(rates2), len(rates0))
+        rates2, rates0 = rates2[:n], rates0[:n]
+        print(f"headline: OOM mid-interleave after {n} paired windows; "
+              "reporting the completed pairs", file=sys.stderr)
+    return _stats(rates2), _stats(rates0), b2, interleaved
 
 
 # ---------------------------------------------------------------------------
@@ -412,132 +570,288 @@ def selftest():
     results = {"platform": jax.default_backend()}
     key = jax.random.PRNGKey(0)
 
+    def entry(name, fn):
+        """Isolate each kernel's comparison: one OOM/compile failure must
+        not wipe the other kernels' evidence (degrade, don't die)."""
+        try:
+            results[name] = fn()
+        except Exception as e:  # noqa: BLE001
+            results[name] = {"error": str(e)[:200]}
+
     # flash attention: bf16 production dtype, causal (the GPT path)
     b, h, s, d = 2, 8, 1024, 64
     kq, kk, kv = jax.random.split(key, 3)
     q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
     k = jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
     v = jax.random.normal(kv, (b, h, s, d), jnp.bfloat16)
-    results["flash_attention"] = _compare(
+    entry("flash_attention", lambda: _compare(
         partial(flash_attention, causal=True, impl="pallas"),
         partial(flash_attention, causal=True, impl="xla"),
-        (q, k, v), tol_norm=2e-2, grad_argnums=(0, 1, 2))
+        (q, k, v), tol_norm=2e-2, grad_argnums=(0, 1, 2)))
+
+    # long-sequence STREAMED flash attention: s=8192, packed segment ids +
+    # causal — exactly the config that hit the resident layout's 16 MB VMEM
+    # wall in r3 (VERDICT r3 ask #3 done-criterion). Compared against the
+    # XLA mask at small heads so the dense reference fits HBM.
+    def long_stream():
+        b8, h8, s8, d8 = 1, 2, 8192, 64
+        q8 = jax.random.normal(kq, (b8, h8, s8, d8), jnp.bfloat16)
+        k8 = jax.random.normal(kk, (b8, h8, s8, d8), jnp.bfloat16)
+        v8 = jax.random.normal(kv, (b8, h8, s8, d8), jnp.bfloat16)
+        seg = jnp.repeat(jnp.arange(8, dtype=jnp.int32), s8 // 8)[None]
+        return _compare(
+            partial(flash_attention, segment_ids=(seg, seg), causal=True,
+                    contiguous_segments=True, impl="pallas",
+                    stream="always"),
+            partial(flash_attention, segment_ids=(seg, seg), causal=True,
+                    impl="xla"),
+            (q8, k8, v8), tol_norm=2e-2, grad_argnums=(0, 1, 2))
+
+    entry("flash_attention_8k_segments_streamed", long_stream)
 
     # fused LN / RMSNorm: bf16 x, fp32 gamma/beta (the MixedFused contract)
     x = jax.random.normal(key, (512, 1024), jnp.bfloat16)
     wln = 1.0 + 0.1 * jax.random.normal(kq, (1024,), jnp.float32)
     bln = 0.1 * jax.random.normal(kk, (1024,), jnp.float32)
-    results["layer_norm"] = _compare(
+    entry("layer_norm", lambda: _compare(
         partial(layer_norm, impl="pallas"), partial(layer_norm, impl="xla"),
-        (x, wln, bln), tol_norm=2e-2, grad_argnums=(0, 1, 2))
-    results["rms_norm"] = _compare(
+        (x, wln, bln), tol_norm=2e-2, grad_argnums=(0, 1, 2)))
+    entry("rms_norm", lambda: _compare(
         partial(rms_norm, impl="pallas"), partial(rms_norm, impl="xla"),
-        (x, wln), tol_norm=2e-2, grad_argnums=(0, 1))
+        (x, wln), tol_norm=2e-2, grad_argnums=(0, 1)))
 
     # scaled-mask softmax (causal, the Megatron kernel pair)
     logits = jax.random.normal(key, (4, 8, 256, 256), jnp.bfloat16)
-    results["scaled_masked_softmax"] = _compare(
+    entry("scaled_masked_softmax", lambda: _compare(
         partial(scaled_masked_softmax, scale=0.125, causal=True,
                 impl="pallas"),
         partial(scaled_masked_softmax, scale=0.125, causal=True, impl="xla"),
-        (logits,), tol_norm=2e-2, grad_argnums=(0,))
+        (logits,), tol_norm=2e-2, grad_argnums=(0,)))
 
     # fused label-smoothing CE (fp32 logits like the vocab head)
     vlog = jax.random.normal(key, (1024, 8192), jnp.float32)
     labels = jax.random.randint(kq, (1024,), 0, 8192)
-    results["xentropy"] = _compare(
+    entry("xentropy", lambda: _compare(
         partial(softmax_cross_entropy, smoothing=0.1, impl="pallas"),
         partial(softmax_cross_entropy, smoothing=0.1, impl="xla"),
-        (vlog, labels), tol_norm=1e-3, grad_argnums=(0,))
+        (vlog, labels), tol_norm=1e-3, grad_argnums=(0,)))
 
     # chunked LM-head CE vs the unchunked reference (both XLA; the chunk
     # scan's accumulation order is what is under test)
     hs = jax.random.normal(key, (4, 256, 512), jnp.bfloat16)
     wte = jax.random.normal(kk, (8192, 512), jnp.bfloat16)
     tgt = jax.random.randint(kv, (4, 256), 0, 8192)
-    results["lm_head_loss"] = _compare(
+    entry("lm_head_loss", lambda: _compare(
         lambda hh, ww: lm_head_cross_entropy(hh, ww, tgt, num_chunks=8),
         lambda hh, ww: lm_head_cross_entropy_reference(hh, ww, tgt),
-        (hs, wte), tol_norm=2e-2, grad_argnums=(0, 1))
+        (hs, wte), tol_norm=2e-2, grad_argnums=(0, 1)))
 
     results["all_ok"] = all(
-        v.get("ok", True) for v in results.values() if isinstance(v, dict))
+        v.get("ok", False if "error" in v else True)
+        for v in results.values() if isinstance(v, dict))
     return results
 
 
+def _gpt_headline_evidence(batch, seq, steps):
+    """345M interleaved headline. Returns ``(result_fragment, errors)``."""
+    frag, errs = {}, {}
+    try:
+        fused, base, common, inter = gpt_headline(batch, seq, steps)
+        frag["value"] = fused["median"]
+        if base is not None:
+            frag["vs_baseline"] = round(fused["median"] / base["median"], 3)
+            frag["spread"] = {"o2": fused, "o0": base, "interleaved": inter}
+        else:
+            frag["spread"] = {"o2": fused, "interleaved": False}
+            errs["baseline"] = ("fp32 O0 leg unplaceable under current HBM "
+                               "pressure; vs_baseline omitted")
+        if common != batch:
+            frag["effective_batch"] = common
+        print(f"headline: {frag['value']} tok/s "
+              f"x{frag.get('vs_baseline')}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        if not _is_oom(e):
+            raise
+        errs["headline"] = str(e)[:300]
+        print(f"headline FAILED: {e}", file=sys.stderr)
+    return frag, errs
+
+
+def _gpt_degraded_evidence(batch, seq, steps):
+    """Degraded rungs: 110M-ish (h=768, L=12), then the 4-layer config the
+    r3 judge saw run under the pressure that OOM'd the 345M. Reported
+    under their OWN key, never substituted for the headline (VERDICT r3
+    ask #1). Returns ``(result_fragment, errors)``."""
+    frag, errs = {}, {}
+    for hid, lay in ((768, 12), (512, 4)):
+        try:
+            fused, base, common, inter = gpt_headline(
+                max(batch // 2, 1), seq, steps, hidden=hid, layers=lay)
+            entry = {
+                "tokens_per_sec": fused["median"],
+                "spread": {"o2": fused, "interleaved": inter},
+                "batch": common, "hidden": hid, "layers": lay}
+            if base is not None:
+                entry["vs_baseline"] = round(
+                    fused["median"] / base["median"], 3)
+                entry["spread"]["o0"] = base
+            frag["gpt_degraded"] = entry
+            print(f"gpt_degraded: {frag['gpt_degraded']}", file=sys.stderr)
+            break
+        except Exception as e:  # noqa: BLE001
+            if not _is_oom(e):
+                raise
+            errs["gpt_degraded"] = str(e)[:300]
+            print(f"gpt_degraded h={hid} FAILED: {e}", file=sys.stderr)
+    return frag, errs
+
+
 def main():
+    """Degrade, don't die (CLAUDE.md): round 3's entire on-chip record was
+    lost because the 345M headline ran first, unprotected, and OOM'd
+    (VERDICT r3 weak #1). Now the GPT phases run in fresh subprocesses
+    that own the chip alone (see stage 0 below for the measured why),
+    every parent stage is individually wrapped, failures land in an
+    ``"errors"`` field, and the JSON line ALWAYS prints with exit 0."""
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = 1024
     steps = int(os.environ.get("BENCH_STEPS", "10"))
-    print(f"platform: {jax.default_backend()}", file=sys.stderr)
-
-    fused_rates, fused_batch = measure_resilient("O2", "auto", batch, seq, steps)
-    fused = _stats(fused_rates)
-    print(f"O2+fused: {fused} (batch {fused_batch})", file=sys.stderr)
-    base_rates, base_batch = measure_resilient("O0", "xla", batch, seq, steps)
-    base = _stats(base_rates)
-    print(f"O0 fp32 unfused: {base} (batch {base_batch})", file=sys.stderr)
-
-    ratio_fused, ratio_base = fused["median"], base["median"]
-    if fused_batch != base_batch:
-        # batch size changes utilization: re-measure the larger-batch config
-        # at the common (smaller) batch so the ratio compares like with like
-        common = min(fused_batch, base_batch)
-        if fused_batch > common:
-            r, _ = measure_resilient("O2", "auto", common, seq, steps)
-            ratio_fused = _stats(r)["median"]
-        else:
-            r, _ = measure_resilient("O0", "xla", common, seq, steps)
-            ratio_base = _stats(r)["median"]
-        print(f"ratio re-measured at common batch {common}", file=sys.stderr)
-
     result = {
         "metric": "gpt2_345m_o2_train_tokens_per_sec",
-        "value": fused["median"],
+        "value": None,
         "unit": "tokens/s",
-        "vs_baseline": round(ratio_fused / ratio_base, 3),
-        # same-session medians + spread: the noise band that makes
-        # round-over-round deltas attributable (VERDICT r2 weak #4)
-        "spread": {"o2": fused, "o0": base},
+        "vs_baseline": None,
     }
-    if fused_batch != batch or base_batch != batch:
-        # record the actually-measured config when OOM retries shrank it
-        result["effective_batch"] = {"o2": fused_batch, "o0": base_batch}
+    errors = {}
 
-    # BASELINE.md configs 1-3, measured on the same chip/session
-    # (VERDICT r2 weak #1: the conv/BN and LAMB paths need TPU numbers)
-    for key, fn in (("resnet50_o2_imgs_per_sec", bench_resnet50),
-                    ("bert_large_lamb_tokens_per_sec", bench_bert_lamb)):
+    def stage(key, fn):
+        """Run one evidence stage; on failure record the error and move on.
+        gc between stages so a finished (or failed) stage's device buffers
+        are truly returned before the next stage allocates."""
+        import gc
+
         try:
             result[key] = fn()
             print(f"{key}: {result[key]}", file=sys.stderr)
-        except Exception as e:  # noqa: BLE001 - never lose the headline metric
-            print(f"{key} failed: {e}", file=sys.stderr)
+            return result[key]
+        except Exception as e:  # noqa: BLE001 - never lose the record
+            print(f"{key} FAILED: {e}", file=sys.stderr)
+            errors[key] = str(e)[:300]
+            return None
+        finally:
+            gc.collect()
 
-    # BASELINE.md target #3, measured directly: fused whole-tree optimizer
-    # step vs unfused per-leaf eager Adam (benchmarks/optimizer_step.py).
     try:
-        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
-        from optimizer_step import measure_speedup
+        # 0. the GPT headline — FIRST, each phase in a FRESH SUBPROCESS
+        # that owns the chip alone. Measured live in r4: configs that OOM
+        # at batch 1 inside (or concurrently with) a long bench process
+        # run at 65k+ tok/s in a fresh process seconds later, with
+        # jax.live_arrays() empty both times — a long process holds HBM
+        # below the Python layer through the tunnel. The parent has not
+        # touched the backend yet at this point, and its later stages are
+        # individually wrapped, so the r3 failure mode (headline crash
+        # wipes the round's record) cannot recur.
+        def run_sub(flag):
+            import subprocess
 
-        speedup, _, _ = measure_speedup(fused_steps=5, eager_steps=2)
-        result["fused_opt_step_vs_eager"] = round(speedup, 2)
-    except Exception as e:  # noqa: BLE001 - never lose the headline metric
-        print(f"optimizer-step microbench failed: {e}", file=sys.stderr)
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), flag],
+                capture_output=True, text=True, timeout=2700)
+            sys.stderr.write(out.stderr[-4000:])
+            frag = json.loads(out.stdout.strip().splitlines()[-1])
+            errors.update(frag.pop("errors", {}))
+            result.update(frag)
+            return frag
 
-    # compiled-kernel numerics on this chip (VERDICT r2 weak #2)
-    try:
-        result["selftest"] = selftest()
-        print(f"selftest all_ok={result['selftest']['all_ok']}", file=sys.stderr)
-    except Exception as e:  # noqa: BLE001
-        print(f"selftest failed: {e}", file=sys.stderr)
-        result["selftest"] = {"error": str(e)[:200]}
+        try:
+            frag = run_sub("--gpt-headline")
+            if "value" not in frag:
+                run_sub("--gpt-degraded")
+        except Exception as e:  # noqa: BLE001 - spawn/parse failure
+            print(f"gpt subprocess FAILED ({e}); running in-process",
+                  file=sys.stderr)
+            errors["gpt_subprocess"] = str(e)[:200]
+            frag, errs = _gpt_headline_evidence(batch, seq, steps)
+            result.update(frag)
+            errors.update(errs)
+            if "value" not in frag:
+                frag, errs = _gpt_degraded_evidence(batch, seq, steps)
+                result.update(frag)
+                errors.update(errs)
 
+        print(f"platform: {jax.default_backend()}", file=sys.stderr)
+
+        # 1. compiled-kernel numerics: tiny footprint, highest evidence value
+        stage("selftest", selftest)
+
+        # 2. fused whole-tree optimizer step vs unfused per-leaf eager Adam
+        # (BASELINE.md target #3; benchmarks/optimizer_step.py)
+        def opt_micro():
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+            from optimizer_step import measure_speedup
+
+            speedup, _, _ = measure_speedup(fused_steps=5, eager_steps=2)
+            return round(speedup, 2)
+
+        stage("fused_opt_step_vs_eager", opt_micro)
+
+        # 3-4. BASELINE.md configs 1-3: conv/BN and LAMB paths, own OOM
+        # ladders with batch floors well below the headline's footprint
+        stage("resnet50_o2_imgs_per_sec", bench_resnet50)
+        stage("bert_large_lamb_tokens_per_sec", bench_bert_lamb)
+
+        # 4b. MEASURED per-scope seconds (pyprof trace-join, VERDICT r3
+        # ask #5): which scope eats the step, in milliseconds, on this chip
+        def pyprof_seconds():
+            from apex_tpu import pyprof
+            from apex_tpu.models import GPTConfig, GPTModel
+
+            cfg = GPTConfig(
+                vocab_size=50304, hidden_size=512, num_layers=4,
+                num_attention_heads=8, max_seq_len=1024, hidden_dropout=0.0,
+                axis=None, compute_dtype=jnp.bfloat16, remat=False)
+            m = GPTModel(cfg)
+            p = m.init(jax.random.PRNGKey(0))
+            toks = jax.random.randint(jax.random.PRNGKey(1), (2, 1024),
+                                      0, 50304)
+            secs = pyprof.measured_scope_seconds(
+                lambda p: jax.value_and_grad(m.loss)(
+                    p, toks, jnp.roll(toks, -1, -1)),
+                p, steps=3, depth=2)
+            total = secs.pop("<total_device>", 0.0)
+            top = dict(sorted(secs.items(), key=lambda kv: -kv[1])[:6])
+            return {"total_ms": round(total * 1e3, 3),
+                    "scopes_ms": {k: round(v * 1e3, 3)
+                                  for k, v in top.items()}}
+
+        stage("pyprof_scope_seconds", pyprof_seconds)
+
+    except BaseException as e:  # noqa: BLE001 - emit the record even then
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            errors["fatal"] = type(e).__name__
+        else:
+            errors["fatal"] = str(e)[:300]
+        print(f"FATAL: {e}", file=sys.stderr)
+
+    if errors:
+        result["errors"] = errors
     print(json.dumps(result))
+    sys.exit(0)
 
 
 if __name__ == "__main__":
     if "--selftest" in sys.argv:
         print(json.dumps({"selftest": selftest()}))
+    elif "--gpt-headline" in sys.argv or "--gpt-degraded" in sys.argv:
+        # the subprocess entries main() spawns for the GPT phases (fresh
+        # process = fresh HBM through the tunnel)
+        fn = (_gpt_headline_evidence if "--gpt-headline" in sys.argv
+              else _gpt_degraded_evidence)
+        frag, errs = fn(int(os.environ.get("BENCH_BATCH", "8")), 1024,
+                        int(os.environ.get("BENCH_STEPS", "10")))
+        if errs:
+            frag["errors"] = errs
+        print(json.dumps(frag))
     else:
         main()
