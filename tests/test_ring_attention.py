@@ -111,3 +111,73 @@ def test_ring_rejects_nothing_on_odd_shapes(mesh):
     want = mha_reference(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+# -- segment ids riding the ring (VERDICT r3 ask #4) -------------------------
+
+
+def _seg_case(key, s_total):
+    """q/k/v at (B, H, s_total, D) plus a padding-style segment array:
+    batch row 0 pads the last quarter, row 1 the last half — so shards hold
+    genuinely different id slices."""
+    q, k, v = (jax.random.normal(kk, (B, H, s_total, D))
+               for kk in jax.random.split(key, 3))
+    seg = np.ones((B, s_total), np.int32)
+    seg[0, -s_total // 4:] = 0
+    seg[1, -s_total // 2:] = 0
+    return q, k, v, jnp.asarray(seg)
+
+
+def _sharded_seg(mesh, fn):
+    spec = P(None, None, "context", None)
+    sspec = P(None, "context")
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh,
+                      in_specs=(spec, spec, spec, sspec),
+                      out_specs=spec, check_vma=False))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
+def test_ring_segment_ids_match_full(mesh, causal, impl):
+    """Padding mask as segment ids whose kv shards rotate with K/V: the
+    sharded ring equals full attention with the same global mask. S=512 on
+    cp=4 gives 128-token shards, large enough that impl='pallas' really
+    exercises the kernel's segment path (blk_k = 128)."""
+    q, k, v, seg = _seg_case(jax.random.PRNGKey(7), 512)
+    fn = _sharded_seg(mesh, lambda a, b_, c, s: ring_attention(
+        a, b_, c, causal=causal, impl=impl, segment_ids=(s, s), pad_id=0,
+        block_q=128, block_k=128))
+    got = fn(q, k, v, seg)
+    want = mha_reference(q, k, v, causal=causal, segment_ids=(seg, seg),
+                         pad_id=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["pallas", "xla"])
+def test_ring_segment_grads_match_full(mesh, impl):
+    q, k, v, seg = _seg_case(jax.random.PRNGKey(8), 512)
+    cot = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    fn = _sharded_seg(mesh, lambda a, b_, c, s: ring_attention(
+        a, b_, c, causal=True, impl=impl, segment_ids=(s, s), pad_id=0,
+        block_q=128, block_k=128))
+    got = jax.grad(lambda *xs: jnp.sum(fn(*xs, seg) * cot),
+                   argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(
+        lambda *xs: jnp.sum(mha_reference(
+            *xs, causal=True, segment_ids=(seg, seg), pad_id=0) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
+
+
+def test_ulysses_segment_ids_match_full(mesh):
+    q, k, v, seg = _seg_case(jax.random.PRNGKey(10), 512)
+    fn = _sharded_seg(mesh, lambda a, b_, c, s: ulysses_attention(
+        a, b_, c, causal=False, segment_ids=(s, s), pad_id=0))
+    got = fn(q, k, v, seg)
+    want = mha_reference(q, k, v, segment_ids=(seg, seg), pad_id=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
