@@ -137,3 +137,55 @@ def test_report_gpt_attention_mlp_dominate(capsys):
     assert share("attention", "mlp", "head") > 0.8
     assert share("attention") > 0.1
     assert share("mlp") > 0.2
+
+
+# -- measured per-scope seconds (VERDICT r3 ask #5) --------------------------
+
+
+def test_hlo_scope_map_parses_compiled_metadata():
+    """The HLO-metadata join key behind measured_scope_seconds: every
+    instruction's op_name carries the named_scope stack on any backend."""
+    from apex_tpu.pyprof.prof import _hlo_scope_map
+
+    @jax.jit
+    def f(x):
+        with jax.named_scope("attention"):
+            y = x @ x.T
+        with jax.named_scope("mlp"):
+            z = jax.nn.gelu(y @ y)
+        return z.sum()
+
+    x = jnp.ones((128, 128))
+    mapping = _hlo_scope_map(f.lower(x).compile().as_text())
+    scopes = set(mapping.values())
+    assert any("attention" in s for s in scopes), scopes
+    assert any("mlp" in s for s in scopes), scopes
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="device traces only exist on TPU")
+def test_measured_scope_seconds_on_tpu():
+    """On-chip: measured per-scope device time for a GPT step; the model's
+    scoped blocks must account for most of the step and sum to ~total."""
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    cfg = GPTConfig(
+        vocab_size=256, hidden_size=128, num_layers=2,
+        num_attention_heads=4, max_seq_len=128, hidden_dropout=0.0,
+        axis=None, compute_dtype=jnp.float32, remat=False)
+    m = GPTModel(cfg)
+    p = m.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 256)
+    secs = pyprof.measured_scope_seconds(
+        lambda p: jax.value_and_grad(m.loss)(p, toks, jnp.roll(toks, -1, -1)),
+        p, steps=3, depth=2)
+    total = secs.pop("<total_device>")
+    assert total > 0
+    assert abs(sum(secs.values()) - total) < 1e-9
+    blocks = sum(v for k, v in secs.items()
+                 if any(n in k for n in ("attention", "mlp", "head",
+                                         "embed")))
+    # named blocks carry the matmuls; LN/residual layer-body ops land on
+    # the bare jvp()/transpose(jvp()) rows, so the scoped share is well
+    # under 1 on tiny models
+    assert blocks / total > 0.3, secs
