@@ -193,7 +193,10 @@ class GPTModel(TransformerBase):
         if self.cfg.moe_num_experts is None:
             return None
         return {"load_balancing_loss": jnp.zeros(()),
-                "router_z_loss": jnp.zeros(())}
+                "router_z_loss": jnp.zeros(()),
+                # summed over layers by run_layers; divide by num_layers
+                # for the mean per-layer drop rate (pure metric)
+                "dropped_fraction": jnp.zeros(())}
 
     def _layer_aux(self, p: Params, h: jax.Array, key, bias):
         """One pre-LN block body for both FFN variants: dense MLP (aux is

@@ -183,15 +183,25 @@ class MoEMLP:
             "me": jnp.mean(probs, axis=0),  # mean router prob per expert
             "ce": jnp.mean(sel.astype(jnp.float32), axis=0) / self.top_k,
             "zsq": jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+            # fraction of routing selections dropped by the capacity cap
+            # (sel is exactly top_k per token, so the N*k denominator is
+            # shard-constant and the cross-shard pmean in the EP path is
+            # the exact global fraction) — the congestion observability
+            # metric (VERDICT r3 ask #6)
+            "dropped_frac": jnp.sum((sel & ~keep).astype(jnp.float32))
+            / float(sel.shape[0] * self.top_k),
         }
         return dispatch, combine, stats
 
     def _aux_losses(self, stats) -> Dict[str, jax.Array]:
-        """Switch load-balance loss E*sum(me*ce) + ST-MoE router z-loss."""
+        """Switch load-balance loss E*sum(me*ce) + ST-MoE router z-loss,
+        plus the dropped-selection fraction as a pure METRIC (not folded
+        into the loss — GPTModel.aux_to_loss reads only the loss keys)."""
         return {
             "load_balancing_loss": self.num_experts * jnp.sum(
                 stats["me"] * stats["ce"]),
             "router_z_loss": stats["zsq"],
+            "dropped_fraction": lax.stop_gradient(stats["dropped_frac"]),
         }
 
     # -- expert compute -----------------------------------------------------
