@@ -95,8 +95,15 @@ def eager_adam_step(params, m, v, grads, t, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8)
     return unflatten(out_p), unflatten(out_m), unflatten(out_v)
 
 
-def measure_speedup(hidden=768, layers=12, fused_steps=10, eager_steps=3, verbose=True):
-    """Returns (speedup, fused_ms, eager_ms) for one optimizer step."""
+def measure_speedup(hidden=768, layers=12, fused_steps=10, eager_steps=3,
+                    windows=3, verbose=True):
+    """Returns (speedup, fused_ms, eager_ms) for one optimizer step.
+
+    Both sides are timed as MEDIANS over ``windows`` INTERLEAVED windows
+    (fused, eager, fused, eager, …) — through the shared tunnel chip a
+    single un-windowed sample swings several-fold with co-tenant drift
+    (observed 2.9x–38x across identical runs), and interleaving keeps the
+    ratio a comparison of the same minutes (PERF_NOTES.md discipline)."""
     import optax
 
     from apex_tpu.optimizers import FusedAdam
@@ -112,27 +119,39 @@ def measure_speedup(hidden=768, layers=12, fused_steps=10, eager_steps=3, verbos
         updates, state = tx.update(grads, state, params)
         return optax.apply_updates(params, updates), state
 
-    # --- fused: whole-tree update, one compiled program ---
-    p, s = fused_step(params, state, grads)  # compile + warmup
+    # warmups: compile the fused program, exercise the eager dispatch path
+    p, s = fused_step(params, state, grads)
     _fetch(p)
-    t0 = time.perf_counter()
-    for _ in range(fused_steps):
-        p, s = fused_step(p, s, grads)
-    _fetch(p)
-    fused_ms = (time.perf_counter() - t0) / fused_steps * 1e3
-
-    # --- eager: per-leaf unjitted loop ---
     m = jax.tree.map(lambda x: jnp.zeros_like(x), params)
     v = jax.tree.map(lambda x: jnp.zeros_like(x), params)
-    ep, em, ev = eager_adam_step(params, m, v, grads, t=1)  # warmup dispatch path
+    ep, em, ev = eager_adam_step(params, m, v, grads, t=1)
     _fetch(ep)
-    t0 = time.perf_counter()
-    for i in range(eager_steps):
-        ep, em, ev = eager_adam_step(ep, em, ev, grads, t=i + 2)
-    _fetch(ep)
-    eager_ms = (time.perf_counter() - t0) / eager_steps * 1e3
 
-    speedup = eager_ms / fused_ms
+    fused_samples, eager_samples = [], []
+    t = 2
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(fused_steps):
+            p, s = fused_step(p, s, grads)
+        _fetch(p)
+        fused_samples.append((time.perf_counter() - t0) / fused_steps * 1e3)
+
+        t0 = time.perf_counter()
+        for _ in range(eager_steps):
+            ep, em, ev = eager_adam_step(ep, em, ev, grads, t=t)
+            t += 1
+        _fetch(ep)
+        eager_samples.append((time.perf_counter() - t0) / eager_steps * 1e3)
+
+    import statistics
+
+    # pair SAME-WINDOW samples: the median of per-window ratios compares
+    # the two sides under the same minutes of drift, which independent
+    # medians (possibly from different windows) would not
+    speedup = statistics.median(
+        e / f for f, e in zip(fused_samples, eager_samples))
+    fused_ms = statistics.median(fused_samples)
+    eager_ms = statistics.median(eager_samples)
     if verbose:
         print(
             f"optimizer step ({layers}-layer/{hidden}-hidden tree, "
