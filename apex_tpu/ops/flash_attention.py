@@ -1232,12 +1232,21 @@ def _resident_vmem_bytes(sq, sk, d, blk_q, blk_k, itemsize, has_bias,
     """Dominant per-program VMEM residency of the resident layout, for the
     fwd/dQ passes (whole K+V) and the dK/dV pass (whole Q/dO + the
     lane-replicated q-id tile — the ADVICE r3 medium: residency scales
-    with TOTAL tokens, not max_seqlen, on the packed path)."""
+    with TOTAL tokens, not max_seqlen, on the packed path).
+
+    VMEM tiles pad the MINOR dim to the 128-lane vreg width: a head_dim
+    of 32 occupies 128 lanes, and the (sq, 1) lse/delta windows of the
+    dK/dV pass occupy sq x 128 — observed live: a d=32, s=8192 resident
+    dK/dV pass allocates 17.3 MB where the unpadded arithmetic says
+    1.6 MB. The estimate must count PADDED bytes or 'auto' keeps
+    resident layouts that cannot compile."""
+    d_eff = -(-d // _NUM_LANES) * _NUM_LANES
     seg_fwd = (blk_q * _NUM_LANES + _NUM_SUBLANES * sk) * 4 if has_seg else 0
-    fwd = 2 * sk * d * itemsize + (blk_q * sk * 4 if has_bias else 0) + seg_fwd
+    fwd = (2 * sk * d_eff * itemsize
+           + (blk_q * sk * 4 if has_bias else 0) + seg_fwd)
     seg_dkv = (sq * _NUM_LANES + _NUM_SUBLANES * sk) * 4 if has_seg else 0
-    dkv = (3 * sq * d * itemsize  # q, do (+ dq-pass K/V ≈ fwd term)
-           + 2 * sq * 4  # lse + delta
+    dkv = (3 * sq * d_eff * itemsize  # q, do (+ dq-pass K/V ≈ fwd term)
+           + 2 * sq * _NUM_LANES * 4  # lse + delta, lane-padded
            + (sq * blk_k * 4 if has_bias else 0) + seg_dkv)
     return max(fwd, dkv)
 
@@ -1373,7 +1382,12 @@ def flash_attention(
         if stream == "always":
             raise ValueError("stream='always' does not support dense bias; "
                              "use segment_ids/causal for long sequences")
-        do_stream = False  # auto: stay resident, bias needs the dbias pass
+        # auto: the streamed path lacks the dbias pass, and the resident
+        # layout was just estimated NOT to fit VMEM — proceeding into it
+        # would die with an opaque Mosaic allocation failure, so take the
+        # XLA path (functional, HBM-bound) instead
+        do_stream = False
+        use = "xla"
     if use == "xla":
         return mha_reference(q, k, v, bias, causal=causal, scale=scale,
                              segment_ids=segment_ids, pad_id=pad_id)

@@ -337,6 +337,17 @@ def test_stream_auto_threshold():
     # long-context causal at 8k with segments (r3's VMEM-wall case)
     long_seg = _resident_vmem_bytes(8192, 8192, 64, 1024, 1024, 2, False, True)
     assert long_seg > _RESIDENT_VMEM_BUDGET
+    # LANE PADDING must be counted: at d=32, s=8192 the resident dK/dV
+    # pass allocates 17.3 MB on TPU (minor dims pad to 128 lanes; the
+    # (sq, 1) lse/delta windows cost sq*128*4 each) though the unpadded
+    # arithmetic says 1.6 MB — the un-streamable config that failed to
+    # compile live in r4. Must stream.
+    d32 = _resident_vmem_bytes(8192, 8192, 32, 1024, 1024, 2, False, False)
+    assert d32 > _RESIDENT_VMEM_BUDGET
+    # and the padding floor must not push model shapes (1k-2k, d=64) off
+    # the measured-faster resident path
+    assert _resident_vmem_bytes(
+        2048, 2048, 64, 1024, 1024, 2, False, False) <= _RESIDENT_VMEM_BUDGET
 
 
 def test_fully_masked_causal_segment_row_is_zero_both_impls():
