@@ -5,4 +5,5 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     get_forward_backward_func,
     pipeline_specs,
     pipelined_loss_fn,
+    prepare_pipelined_model,
 )

@@ -104,6 +104,63 @@ def deinterleave_stack(layers: Any, pipeline_size: int, virtual_pipeline_size: i
     return jax.tree.map(lambda x: x[inv], layers)
 
 
+def prepare_pipelined_model(
+    model: Any,
+    params: Any,
+    mesh: Any,
+    *,
+    num_microbatches: int,
+    virtual_pipeline_size: int = 1,
+    with_aux: bool = False,
+):
+    """The shared TP x PP setup every pipelined harness needs (reference:
+    the build_model + _forward_backward_pipelining plumbing the Megatron
+    test harnesses repeat, apex/transformer/pipeline_parallel/schedules/
+    common.py:52-65 driven by run_pipeline_parallel_test.py): shard the
+    layer-stack specs over the pipe axis, interleave virtual chunks,
+    place the params on the mesh, and build the pipelined loss.
+
+    Returns ``(specs, sharded_params, pipe_loss)`` where ``pipe_loss`` is
+    ``pipelined_loss_fn``'s ``loss(rest_params, layers_local, batch,
+    targets)``. Callers own the gradient/step assembly (which legitimately
+    differs between harnesses); this factors the wiring that must NOT
+    drift between them (__graft_entry__, benchmarks/gpt_scaling.py,
+    benchmarks/gpt_1p3b_check.py).
+
+    ``with_aux=True`` threads layer aux losses (MoE routers) through
+    ``model.run_layers(..., return_aux=True)`` and ``model.aux_to_loss``.
+    """
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer import tensor_parallel as tp_mod
+
+    all_specs = model.specs()
+    specs = dict(
+        {k: v for k, v in all_specs.items() if k != "layers"},
+        layers=pipeline_specs(all_specs["layers"]),
+    )
+    full = dict(params)
+    if virtual_pipeline_size > 1:
+        pp = mesh_lib.get_pipeline_model_parallel_world_size()
+        full["layers"] = interleave_stack(
+            full["layers"], pp, virtual_pipeline_size)
+    sharded = tp_mod.shard_params(full, specs, mesh)
+    if with_aux:
+        run_layers = lambda lp, h: model.run_layers(lp, h, return_aux=True)  # noqa: E731
+        aux_to_loss = model.aux_to_loss
+    else:
+        run_layers = lambda lp, h: model.run_layers(lp, h)  # noqa: E731
+        aux_to_loss = None
+    pipe_loss = pipelined_loss_fn(
+        embed=model.embed,
+        run_layers=run_layers,
+        head_loss=lambda p, h, t: model.head(p, h, t),
+        num_microbatches=num_microbatches,
+        virtual_pipeline_size=virtual_pipeline_size,
+        aux_to_loss=aux_to_loss,
+    )
+    return specs, sharded, pipe_loss
+
+
 def pipeline_tick_count(
     num_microbatches: int, pipeline_size: int, virtual_pipeline_size: int = 1
 ) -> int:

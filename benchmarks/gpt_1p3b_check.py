@@ -3,7 +3,10 @@ hidden size (h=2048, 32 heads) under TP x PP interleaved, loss-matched
 against the unpipelined serial model.
 
 The reference frames this target as "GPT-3 1.3B, TP=8 x PP=4 interleaved
-on v5e-64: runs, loss-match vs no-pipelining". Multi-chip hardware is not
+on v5e-64: runs, loss-match vs no-pipelining" (BASELINE.md target #5; the
+reference's own harness pattern is the pipeline-vs-serial equivalence of
+tests/L0/run_transformer/run_pipeline_parallel_test.py:33-80 at the
+gpt_scaling_test.py:49-70 model scales). Multi-chip hardware is not
 available in this environment, so the check runs the REAL WIDTH (the
 dimension that stresses sharded-GEMM correctness) at reduced depth/seq on
 the 8-device virtual CPU mesh: tp=2 x pp=4 with interleaved vpp=2, one
@@ -38,12 +41,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu.models import GPTConfig, GPTModel
 from apex_tpu.parallel import collectives, mesh as mesh_lib
-from apex_tpu.transformer import tensor_parallel as tp_mod
-from apex_tpu.transformer.pipeline_parallel import (
-    pipeline_specs,
-    pipelined_loss_fn,
-)
-from apex_tpu.transformer.pipeline_parallel.schedules import interleave_stack
+from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 
 
 def main() -> None:
@@ -94,22 +92,9 @@ def main() -> None:
         virtual_pipeline_model_parallel_size=args.vpp if args.vpp > 1 else None,
     )
     try:
-        all_specs = model.specs()
-        layer_specs = pipeline_specs(all_specs["layers"])
-        rest_specs = {k: v for k, v in all_specs.items() if k != "layers"}
-        specs = dict(rest_specs, layers=layer_specs)
-        full = dict(params)
-        if args.vpp > 1:
-            full["layers"] = interleave_stack(full["layers"], args.pp, args.vpp)
-        sharded = tp_mod.shard_params(full, specs, mesh)
-
-        pipe_loss = pipelined_loss_fn(
-            embed=model.embed,
-            run_layers=lambda lp, h: model.run_layers(lp, h),
-            head_loss=lambda p, h, t: model.head(p, h, t),
-            num_microbatches=args.micro,
-            virtual_pipeline_size=args.vpp,
-        )
+        specs, sharded, pipe_loss = prepare_pipelined_model(
+            model, params, mesh, num_microbatches=args.micro,
+            virtual_pipeline_size=args.vpp)
 
         def fn(p, toks, tgts):
             rest = {k: v for k, v in p.items() if k != "layers"}

@@ -37,8 +37,7 @@ from apex_tpu.parallel.distributed import (
     allreduce_gradients,
     allreduce_gradients_by_spec,
 )
-from apex_tpu.transformer import tensor_parallel as tp_mod
-from apex_tpu.transformer.pipeline_parallel import pipeline_specs, pipelined_loss_fn
+from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 
 # the reference grid, gpt_scaling_test.py:52
 GRID = [(8, 1, 1), (4, 2, 1), (2, 1, 4), (1, 2, 4)]
@@ -66,21 +65,12 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
         policy = amp.get_policy("O2")
         mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-4), policy)
         full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
-        all_specs = model.specs()
-        specs = dict(
-            {k: v for k, v in all_specs.items() if k != "layers"},
-            layers=pipeline_specs(all_specs["layers"]),
-        )
-        params = tp_mod.shard_params(full, specs, mesh)
+        # shared TP x PP wiring (specs, placement, pipelined loss)
+        specs, params, pipe_loss = prepare_pipelined_model(
+            model, full, mesh, num_microbatches=n_micro)
         opt_state = mp_opt.init(params)
-        rest_specs = {k: v for k, v in all_specs.items() if k != "layers"}
+        rest_specs = {k: v for k, v in specs.items() if k != "layers"}
         grad_axes = mesh_lib.get_gradient_reduction_axes()
-        pipe_loss = pipelined_loss_fn(
-            embed=model.embed,
-            run_layers=lambda lp, h: model.run_layers(lp, h),
-            head_loss=lambda p, h, t: model.head(p, h, t),
-            num_microbatches=n_micro,
-        )
         data_spec = P(mesh_lib.AXIS_DATA)
 
         def sharded_grads(p, toks, tgts, scale):
