@@ -39,17 +39,21 @@ from apex_tpu.parallel.distributed import (
 )
 from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 
-# the reference grid, gpt_scaling_test.py:52
-GRID = [(8, 1, 1), (4, 2, 1), (2, 1, 4), (1, 2, 4)]
+# the reference grid, gpt_scaling_test.py:52 — extended with one
+# context-parallel config (dp, tp, pp, cp): ring-attention sequence
+# sharding is this framework's beyond-reference axis and belongs in the
+# round-over-round scaling record
+GRID = [(8, 1, 1), (4, 2, 1), (2, 1, 4), (1, 2, 4), (2, 1, 2, 2)]
 
 
-def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
+def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
                micro_batch, n_micro, steps):
-    n_dev = dp * tp * pp
+    n_dev = dp * tp * pp * cp
     if len(jax.devices()) < n_dev:
         return None
     mesh = mesh_lib.make_virtual_mesh(
-        n_dev, tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp)
+        n_dev, tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp,
+        context_parallel_size=cp)
     try:
         # layer count must divide by pp for the stage shards; record the
         # effective value so ramped sweeps are labeled with what actually ran
@@ -59,6 +63,7 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
             num_layers=eff_layers,
             num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
             axis=mesh_lib.AXIS_MODEL if tp > 1 else None,
+            context_axis=mesh_lib.AXIS_CONTEXT if cp > 1 else None,
             compute_dtype=jnp.bfloat16, remat=True,
         )
         model = GPTModel(cfg)
@@ -71,7 +76,8 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
         opt_state = mp_opt.init(params)
         rest_specs = {k: v for k, v in specs.items() if k != "layers"}
         grad_axes = mesh_lib.get_gradient_reduction_axes()
-        data_spec = P(mesh_lib.AXIS_DATA)
+        data_spec = P(mesh_lib.AXIS_DATA,
+                      mesh_lib.AXIS_CONTEXT if cp > 1 else None)
 
         def sharded_grads(p, toks, tgts, scale):
             rest = {k: v for k, v in p.items() if k != "layers"}
@@ -118,8 +124,11 @@ def run_config(dp, tp, pp, *, hidden, layers, heads, vocab, seq,
             params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
         loss_val = float(loss)  # host fetch forces the whole chain
         dt = (time.perf_counter() - t0) / steps
+        conf = {"dp": dp, "tp": tp, "pp": pp, "layers": eff_layers}
+        if cp > 1:
+            conf["cp"] = cp
         return {
-            "config": {"dp": dp, "tp": tp, "pp": pp, "layers": eff_layers},
+            "config": conf,
             "avg_iteration_time_s": round(dt, 4),
             "tokens_per_sec": round(batch * seq / dt, 1),
             "loss": round(loss_val, 4),
@@ -165,23 +174,29 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
     layers) when ``output_dir`` is set, plus a combined ``scaling_table``;
     returns the result rows."""
     rows = []
-    for dp, tp, pp in grid:
+    for entry in grid:
+        dp, tp, pp = entry[:3]
+        cp = entry[3] if len(entry) > 3 else 1
         for layers in layers_list:
             res = run_config(
-                dp, tp, pp, hidden=hidden, layers=layers, heads=heads,
+                dp, tp, pp, cp, hidden=hidden, layers=layers, heads=heads,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
                 n_micro=n_micro, steps=steps)
             if res is None:
                 # not enough devices — no layer count will change that;
-                # record ONE skipped row for this (dp, tp, pp) and move on
+                # record ONE skipped row for this config and move on
                 res = {"config": {"dp": dp, "tp": tp, "pp": pp},
                        "skipped": "not enough devices"}
+                if cp > 1:
+                    res["config"]["cp"] = cp
                 rows.append(res)
                 print(json.dumps(res), flush=True)
                 break
             res["config"].setdefault("layers", layers)
             eff = res["config"]["layers"]
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "layers": eff}
+            if cp > 1:
+                base_cfg["cp"] = cp
             if any({k: r["config"].get(k) for k in base_cfg} == base_cfg
                    for r in rows):
                 # two requested counts rounded to the same effective config;
@@ -197,7 +212,8 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             print(json.dumps(res), flush=True)
             if output_dir:
                 os.makedirs(output_dir, exist_ok=True)
-                name = f"scaling_dp{dp}_tp{tp}_pp{pp}_l{eff}.json"
+                cp_tag = f"_cp{cp}" if cp > 1 else ""
+                name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
                 with open(os.path.join(output_dir, name), "w") as f:
                     json.dump(res, f, indent=1)
     if output_dir:
@@ -205,16 +221,20 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             json.dump(rows, f, indent=1)
     # the human-readable table the reference prints as
     # "Average Iteration Time" lines (gpt_scaling_test.py:64-70)
-    hdr = f"{'dp':>3} {'tp':>3} {'pp':>3} {'layers':>6} {'iter_s':>9} {'tok/s':>10}"
+    hdr = (f"{'dp':>3} {'tp':>3} {'pp':>3} {'cp':>3} {'layers':>6} "
+           f"{'iter_s':>9} {'tok/s':>10}")
     print(hdr)
     for r in rows:
         c = r["config"]
         if "skipped" in r:
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
-                  f"{c.get('layers', '-'):>6} {'skipped':>9}")
+                  f"{c.get('cp', 1):>3} {c.get('layers', '-'):>6} "
+                  f"{'skipped':>9}")
         else:
-            print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} {c['layers']:>6} "
-                  f"{r['avg_iteration_time_s']:>9.4f} {r['tokens_per_sec']:>10.1f}")
+            print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
+                  f"{c.get('cp', 1):>3} {c['layers']:>6} "
+                  f"{r['avg_iteration_time_s']:>9.4f} "
+                  f"{r['tokens_per_sec']:>10.1f}")
     return rows
 
 
