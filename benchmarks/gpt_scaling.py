@@ -194,10 +194,12 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                 break
             res["config"].setdefault("layers", layers)
             eff = res["config"]["layers"]
-            base_cfg = {"dp": dp, "tp": tp, "pp": pp, "layers": eff}
-            if cp > 1:
-                base_cfg["cp"] = cp
-            if any({k: r["config"].get(k) for k in base_cfg} == base_cfg
+            # compare with cp DEFAULTED ON BOTH SIDES: projecting a stored
+            # cp>1 row down to a cp-less key set would make a later plain
+            # config look like its duplicate and silently skip it
+            base_cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
+                        "layers": eff}
+            if any({k: r["config"].get(k, 1) for k in base_cfg} == base_cfg
                    for r in rows):
                 # two requested counts rounded to the same effective config;
                 # don't record the same measurement twice under two labels
