@@ -1226,6 +1226,10 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # headroom for double buffering, accumulators, and Mosaic temporaries.
 _RESIDENT_VMEM_BUDGET = 6 * 1024 * 1024
 
+# one-time hint that a packed (non-decreasing) segment layout was passed
+# without opting into block skipping (ADVICE r4 low #4)
+_WARNED_PACKED_OPT_IN = False
+
 
 def _resident_vmem_bytes(sq, sk, d, blk_q, blk_k, itemsize, has_bias,
                          has_seg):
@@ -1345,6 +1349,7 @@ def flash_attention(
     use = _resolve_impl(impl)
     if use == "pallas" and not _supported(sq, sk, d):
         use = "xla"
+    global _WARNED_PACKED_OPT_IN
     blk_q = _pick_block(sq, block_q)
     blk_k = _pick_block(sk, block_k)
     if segment_ids is not None:
@@ -1353,26 +1358,54 @@ def flash_attention(
             raise ValueError(
                 f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} do not "
                 f"match (batch, seq) = ({b}, {sq})/({b}, {sk})")
-        if contiguous_segments and not any(
+        if (contiguous_segments or not _WARNED_PACKED_OPT_IN) and not any(
                 isinstance(s, jax.core.Tracer) for s in (q_seg, kv_seg)):
+            # once the one-time hint has fired, mask-only callers skip the
+            # scan entirely — np.asarray on concrete device arrays is a
+            # host fetch per call (a tunnel round-trip through axon)
             # block skipping is only sound for non-decreasing ids; with
             # concrete ids enforce it here (traced ids: the caller owns the
             # guarantee, like the reference's static bucket dispatch)
             import numpy as _np
 
+            monotone = True
             for name, ids in (("q", q_seg), ("kv", kv_seg)):
                 a = _np.asarray(ids)
                 if (_np.diff(a, axis=-1) < 0).any():
-                    raise ValueError(
-                        f"{name} segment ids are not non-decreasing; pass "
-                        "contiguous_segments=False for non-packed layouts "
-                        "(mask-only, no block skipping)")
+                    monotone = False
+                    if contiguous_segments:
+                        raise ValueError(
+                            f"{name} segment ids are not non-decreasing; "
+                            "pass contiguous_segments=False for non-packed "
+                            "layouts (mask-only, no block skipping)")
+            if monotone and not contiguous_segments:
+                # packed layout detected but block skipping left off: the
+                # default is the safe mask-only path, which computes
+                # total^2 score blocks instead of sum(len_i^2) — tell the
+                # caller once so genuinely packed layouts learn to opt in
+                if not _WARNED_PACKED_OPT_IN:
+                    _WARNED_PACKED_OPT_IN = True
+                    import warnings
+
+                    warnings.warn(
+                        "flash_attention: segment ids are non-decreasing "
+                        "(packed layout) but contiguous_segments=False; "
+                        "pass contiguous_segments=True to enable block "
+                        "skipping (cost sum(len_i^2) instead of total^2)",
+                        stacklevel=2)
         # the lane-replicated kernel layout needs 128-aligned k blocks
         blk_k = _pick_block(sk, block_k, mult=_NUM_LANES)
         if blk_k % _NUM_LANES or sk % blk_k:
             use = "xla"
     if stream not in ("auto", "never", "always"):
         raise ValueError(f"stream must be auto|never|always, got {stream!r}")
+    if use == "xla":
+        # explicit impl="xla" (or an unsupported-shape fallback): the dense
+        # path supports bias and ignores streaming, so return before the
+        # stream-vs-bias checks (ADVICE r4: stream="always" + bias must not
+        # reject an explicitly requested, working XLA path)
+        return mha_reference(q, k, v, bias, causal=causal, scale=scale,
+                             segment_ids=segment_ids, pad_id=pad_id)
     do_stream = stream == "always" or (
         stream == "auto"
         and _resident_vmem_bytes(

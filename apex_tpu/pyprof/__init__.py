@@ -24,6 +24,7 @@ facilities:
 from apex_tpu.pyprof.prof import (  # noqa: F401
     annotate,
     cost_analysis,
+    measured_kind_seconds,
     measured_report,
     measured_scope_seconds,
     per_scope_costs,

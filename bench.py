@@ -35,6 +35,24 @@ BERT, pyprof scope seconds) with every stage individually wrapped. Stage
 failures land in "errors"; the JSON line always prints and the process
 always exits 0. The headline's O2/O0 windows are interleaved in time so
 vs_baseline is robust to co-tenant drift ("interleaved": true in spread).
+
+Baseline discipline (VERDICT r4 ask #1): the fp32 O0 leg is as
+indestructible as the O2 headline. When the interleaved/sequential
+in-process baseline fails, a FRESH "--gpt-o0" subprocess (its own OOM
+ladder + sleep-retries, nothing else in its HBM) retries the 345M fp32
+leg; a ratio from that path is marked spread.ratio_mode =
+"cross_process_sequential" with both batches stated. If the 345M ratio is
+still missing — or was never interleaved — the degraded rung (which
+co-resides easily) supplies an INTERLEAVED ratio under
+"vs_baseline_degraded": clearly labelled, never substituted for
+"vs_baseline".
+
+The headline subprocess also records MEASURED per-scope/per-op-kind
+device seconds for the real 345M step (pyprof trace-join, VERDICT r4 ask
+#2), and the ResNet/BERT rungs are bracketed by a fixed chained-matmul
+canary program whose TF/s is recorded alongside them, so cross-round
+drift in those single-config rungs is attributable to co-tenant load
+(VERDICT r4 ask #6).
 """
 
 from __future__ import annotations
@@ -395,6 +413,46 @@ def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
     return _stats(rates2), _stats(rates0), b2, interleaved
 
 
+def _canary(windows=3):
+    """Fixed chained-matmul program (4096x4096 bf16, 100 links in one
+    scan) timed with the tunnel fetch discipline — the SAME program every
+    round, so its median TF/s is a co-tenant drift reference. Recorded
+    next to the single-config ResNet/BERT rungs (VERDICT r4 weak #4:
+    1,721 -> 1,667 imgs/s across rounds was unattributable). Each link is
+    rescaled by 1/sqrt(n) so bf16 magnitudes stay ~1 over 100 links; the
+    scalar-sum return forces the whole chain on fetch. Returns median
+    TF/s (2*4096^3*100 ≈ 13.7 TFLOP/call ≈ 200 ms on this chip: long
+    enough that the ~40 ms per-program tunnel dispatch does not
+    dominate)."""
+    import math
+
+    from jax import lax
+
+    n, chain = 4096, 100
+    a = jax.random.normal(jax.random.PRNGKey(3), (n, n), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(4), (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def run(a, w):
+        inv = jnp.bfloat16(1.0 / math.sqrt(n))
+
+        def body(c, _):
+            return (c @ w) * inv, None
+
+        out, _ = lax.scan(body, a, None, length=chain)
+        return jnp.sum(out.astype(jnp.float32))
+
+    assert jnp.isfinite(float(run(a, w)))  # compile + execute
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        v = float(run(a, w))
+        dt = time.perf_counter() - t0
+        assert jnp.isfinite(v), "canary chain went non-finite"
+        rates.append(2 * n ** 3 * chain / dt / 1e12)
+    return _stats(rates)["median"]
+
+
 # ---------------------------------------------------------------------------
 # ResNet-50 O2 + FusedSGD (BASELINE.md configs 1-2: the named headline
 # metric "ResNet-50 imgs/sec/chip (amp O2-equivalent)"). Single chip, so
@@ -664,6 +722,67 @@ def selftest():
     return results
 
 
+def _profile_345m(batch, seq, steps=3):
+    """MEASURED per-scope and per-op-kind device seconds for the REAL
+    345M train step (VERDICT r4 ask #2: the toy-model profile said nothing
+    about where the headline's ~260 ms goes). Runs inside the headline
+    subprocess, which owns the chip; single-step dispatch (no scan), so
+    total_ms is device time per step. Tries the remat ladder and a halved
+    batch before giving up."""
+    import gc
+
+    if jax.default_backend() != "tpu":
+        return None, {}
+    from apex_tpu.pyprof.prof import _measured_join
+
+    errs = {}
+    for remat_policy, b in (("save_attn", batch), (None, batch),
+                            (None, max(batch // 2, 1))):
+        try:
+            step, params, opt_state = build("O2", "auto", remat_policy)
+            tokens = jax.random.randint(jax.random.PRNGKey(1), (b, seq),
+                                        0, 50304)
+            targets = jnp.roll(tokens, -1, axis=-1)
+
+            def prof_fn(params, opt_state, tokens, targets):
+                # loss first so the execution barrier fetches a scalar;
+                # params/state returned too so the optimizer update is
+                # not dead-code-eliminated out of the profiled program
+                p, s, loss, _ = step(params, opt_state, tokens, targets)
+                return loss, p, s
+
+            scopes, kinds = _measured_join(
+                prof_fn, params, opt_state, tokens, targets,
+                steps=steps, depth=2)
+            total = scopes.pop("<total_device>", 0.0)
+            kinds.pop("<total_device>", None)
+            top = dict(sorted(scopes.items(), key=lambda kv: -kv[1])[:10])
+            hid = int(os.environ.get("BENCH_HIDDEN", "1024"))
+            lay = int(os.environ.get("BENCH_LAYERS", "24"))
+            label = ("gpt2_345m" if (hid, lay) == (1024, 24)
+                     else f"gpt_h{hid}_L{lay}")
+            errs.pop("pyprof_345m", None)  # an earlier rung's OOM is not
+            # an error once a later rung delivered the profile
+            return {
+                "model": label, "batch": b, "seq": seq,
+                "remat": remat_policy or "full",
+                "dispatch_mode": "single_step",
+                "total_ms": round(total * 1e3, 3),
+                "scopes_ms": {k: round(v * 1e3, 3) for k, v in top.items()},
+                "kinds_ms": {k: round(v * 1e3, 3)
+                             for k, v in sorted(kinds.items(),
+                                                key=lambda kv: -kv[1])[:12]},
+            }, errs
+        except Exception as e:  # noqa: BLE001
+            if not _is_oom(e):
+                raise
+            errs["pyprof_345m"] = str(e)[:200]
+            print(f"profile_345m: OOM at remat={remat_policy} b={b}",
+                  file=sys.stderr)
+            gc.collect()
+    return None, errs
+
+
 def _gpt_headline_evidence(batch, seq, steps):
     """345M interleaved headline. Returns ``(result_fragment, errors)``."""
     frag, errs = {}, {}
@@ -686,6 +805,41 @@ def _gpt_headline_evidence(batch, seq, steps):
             raise
         errs["headline"] = str(e)[:300]
         print(f"headline FAILED: {e}", file=sys.stderr)
+    if "value" in frag:
+        # measured scope/kind attribution of the step just benchmarked —
+        # in this subprocess because it owns the chip (the parent's HBM
+        # view is polluted by its own stages)
+        import gc
+
+        gc.collect()
+        try:
+            prof, perrs = _profile_345m(frag.get("effective_batch", batch),
+                                        seq)
+            errs.update(perrs)
+            if prof is not None:
+                frag["pyprof_scope_seconds"] = prof
+        except Exception as e:  # noqa: BLE001 - profiling must not cost
+            errs["pyprof_345m"] = str(e)[:200]  # the headline its record
+    return frag, errs
+
+
+def _gpt_o0_evidence(batch, seq, steps):
+    """The fp32 O0 baseline leg in its OWN fresh process (VERDICT r4 ask
+    #1: one co-tenant spike must not delete the ratio for the round). The
+    full ladder plus sleep-retries gets the ~5.6 GB batch-independent
+    fp32 footprint placed once transient pressure passes; the parent
+    computes the per-token ratio from the two processes' medians."""
+    frag, errs = {}, {}
+    try:
+        rates, b0 = measure_resilient("O0", "xla", batch, seq, steps,
+                                      retries=2, retry_sleep=45)
+        frag["o0"] = dict(_stats(rates), batch=b0)
+        print(f"o0 baseline: {frag['o0']}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        if not _is_oom(e):
+            raise
+        errs["o0_baseline"] = str(e)[:300]
+        print(f"o0 baseline FAILED: {e}", file=sys.stderr)
     return frag, errs
 
 
@@ -763,22 +917,65 @@ def main():
         # touched the backend yet at this point, and its later stages are
         # individually wrapped, so the r3 failure mode (headline crash
         # wipes the round's record) cannot recur.
-        def run_sub(flag):
+        def run_sub(flag, update=True, timeout=2700, env=None):
             import subprocess
 
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), flag],
-                capture_output=True, text=True, timeout=2700)
+                capture_output=True, text=True, timeout=timeout,
+                env=None if env is None else dict(os.environ, **env))
             sys.stderr.write(out.stderr[-4000:])
             frag = json.loads(out.stdout.strip().splitlines()[-1])
             errors.update(frag.pop("errors", {}))
-            result.update(frag)
+            if update:
+                result.update(frag)
             return frag
 
+        degraded_attempted = False
         try:
             frag = run_sub("--gpt-headline")
             if "value" not in frag:
+                degraded_attempted = True
                 run_sub("--gpt-degraded")
+            elif "vs_baseline" not in frag:
+                # the in-process fp32 leg died; a FRESH subprocess that
+                # owns the chip alone retries it with the full ladder +
+                # sleep-retries (VERDICT r4 ask #1 — the ratio must not
+                # vanish with one co-tenant spike). Cross-process medians
+                # are sequential, not interleaved: labelled as such, with
+                # both legs' batches stated.
+                try:
+                    # seed the fresh process at the O2 leg's EFFECTIVE
+                    # batch so the ratio compares like with like when the
+                    # fp32 leg fits there (its own ladder can still halve)
+                    o0 = run_sub(
+                        "--gpt-o0", update=False, timeout=1800,
+                        env={"BENCH_BATCH":
+                             str(result.get("effective_batch", batch))})
+                except Exception as e:  # noqa: BLE001
+                    o0 = {}
+                    errors["o0_subprocess"] = str(e)[:200]
+                if "o0" in o0:
+                    base = o0["o0"]
+                    result["vs_baseline"] = round(
+                        result["value"] / base["median"], 3)
+                    errors.pop("baseline", None)
+                    sp = result.setdefault("spread", {})
+                    sp["o0"] = base
+                    sp["o2_batch"] = result.get("effective_batch", batch)
+                    sp["interleaved"] = False
+                    sp["ratio_mode"] = "cross_process_sequential"
+            if (result.get("vs_baseline") is None
+                    or not result.get("spread", {}).get("interleaved")):
+                # no interleaved 345M ratio this session: the degraded
+                # rung's two small programs co-reside easily, so it
+                # supplies INTERLEAVED ratio evidence (recorded under
+                # vs_baseline_degraded below — never substituted). Skip
+                # if this round already attempted (and failed) it: a
+                # back-to-back identical retry under the same pressure
+                # just burns the timeout twice.
+                if not degraded_attempted:
+                    run_sub("--gpt-degraded")
         except Exception as e:  # noqa: BLE001 - spawn/parse failure
             print(f"gpt subprocess FAILED ({e}); running in-process",
                   file=sys.stderr)
@@ -786,10 +983,13 @@ def main():
             frag, errs = _gpt_headline_evidence(batch, seq, steps)
             result.update(frag)
             errors.update(errs)
-            if "value" not in frag:
+            if "value" not in frag or "vs_baseline" not in frag:
                 frag, errs = _gpt_degraded_evidence(batch, seq, steps)
                 result.update(frag)
                 errors.update(errs)
+        d = result.get("gpt_degraded") or {}
+        if "vs_baseline" in d:
+            result["vs_baseline_degraded"] = d["vs_baseline"]
 
         print(f"platform: {jax.default_backend()}", file=sys.stderr)
 
@@ -809,12 +1009,32 @@ def main():
         stage("fused_opt_step_vs_eager", opt_micro)
 
         # 3-4. BASELINE.md configs 1-3: conv/BN and LAMB paths, own OOM
-        # ladders with batch floors well below the headline's footprint
+        # ladders with batch floors well below the headline's footprint.
+        # Both rungs are BRACKETED by the fixed canary program so their
+        # cross-round drift is attributable (VERDICT r4 weak #4).
+        def safe_canary():
+            try:
+                return _canary()
+            except Exception as e:  # noqa: BLE001
+                print(f"canary FAILED: {e}", file=sys.stderr)
+                return None
+
+        c_pre = safe_canary()
         stage("resnet50_o2_imgs_per_sec", bench_resnet50)
+        c_mid = safe_canary()
         stage("bert_large_lamb_tokens_per_sec", bench_bert_lamb)
+        c_post = safe_canary()
+        for key, before, after in (
+                ("resnet50_o2_imgs_per_sec", c_pre, c_mid),
+                ("bert_large_lamb_tokens_per_sec", c_mid, c_post)):
+            if isinstance(result.get(key), dict):
+                result[key]["canary_tf_s"] = {"before": before,
+                                              "after": after}
 
         # 4b. MEASURED per-scope seconds (pyprof trace-join, VERDICT r3
-        # ask #5): which scope eats the step, in milliseconds, on this chip
+        # ask #5). The headline subprocess already profiled the REAL 345M
+        # step (r4 ask #2); this toy-model stage is only the fallback so
+        # a round whose headline died still records SOME measured scopes.
         def pyprof_seconds():
             from apex_tpu import pyprof
             from apex_tpu.models import GPTConfig, GPTModel
@@ -837,7 +1057,8 @@ def main():
                     "scopes_ms": {k: round(v * 1e3, 3)
                                   for k, v in top.items()}}
 
-        stage("pyprof_scope_seconds", pyprof_seconds)
+        if "pyprof_scope_seconds" not in result:
+            stage("pyprof_scope_seconds", pyprof_seconds)
 
     except BaseException as e:  # noqa: BLE001 - emit the record even then
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
@@ -855,10 +1076,12 @@ def main():
 if __name__ == "__main__":
     if "--selftest" in sys.argv:
         print(json.dumps({"selftest": selftest()}))
-    elif "--gpt-headline" in sys.argv or "--gpt-degraded" in sys.argv:
+    elif ("--gpt-headline" in sys.argv or "--gpt-degraded" in sys.argv
+          or "--gpt-o0" in sys.argv):
         # the subprocess entries main() spawns for the GPT phases (fresh
         # process = fresh HBM through the tunnel)
         fn = (_gpt_headline_evidence if "--gpt-headline" in sys.argv
+              else _gpt_o0_evidence if "--gpt-o0" in sys.argv
               else _gpt_degraded_evidence)
         frag, errs = fn(int(os.environ.get("BENCH_BATCH", "8")), 1024,
                         int(os.environ.get("BENCH_STEPS", "10")))

@@ -57,6 +57,12 @@ def main() -> None:
                     help="global batch (default: dp)")
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring")
+    ap.add_argument("--lm-head-chunks", type=int, default=None,
+                    help="chunked LM-head CE (at 32k tokens the full "
+                         "(tokens, vocab) logits tensor alone is ~2 GB; "
+                         "chunking keeps the head's peak HBM flat)")
+    ap.add_argument("--output", default=None,
+                    help="write a JSON measurement record")
     args = ap.parse_args()
 
     n = args.cp * args.dp
@@ -75,6 +81,7 @@ def main() -> None:
         sequence_parallel_impl=args.sp_impl,
         compute_dtype=jnp.bfloat16,
         remat=True,
+        lm_head_chunks=args.lm_head_chunks,
     )
     model = GPTModel(cfg)
     policy = amp.get_policy("O2")
@@ -141,8 +148,25 @@ def main() -> None:
     steps_timed = max(args.steps - 1, 1)
     dt = (time.perf_counter() - t0) / steps_timed
     mode = "serial" if serial else args.sp_impl
-    print(f"{batch * args.seq / dt:.0f} tokens/s at context {args.seq} "
+    tok_s = batch * args.seq / dt
+    print(f"{tok_s:.0f} tokens/s at context {args.seq} "
           f"(cp={args.cp}, dp={args.dp}, {mode})")
+    if args.output:
+        import json
+
+        os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+        with open(args.output, "w") as f:
+            json.dump({
+                "metric": "longcontext_train_tokens_per_sec",
+                "platform": jax.default_backend(),
+                "seq": args.seq, "cp": args.cp, "dp": args.dp,
+                "mode": mode, "batch": batch,
+                "hidden": args.hidden, "layers": args.layers,
+                "lm_head_chunks": args.lm_head_chunks,
+                "steps_timed": steps_timed,
+                "tokens_per_sec": round(tok_s, 1),
+                "loss_final": round(float(loss), 4),
+            }, f, indent=1)
     if not serial:
         mesh_lib.destroy_model_parallel()
 

@@ -5,11 +5,12 @@ the degraded-rung ladder. The measurement paths themselves are exercised
 on-chip by the driver's bench run.
 """
 
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
 
@@ -76,6 +77,36 @@ def test_headline_evidence_reraises_non_oom(monkeypatch):
     monkeypatch.setattr(bench, "gpt_headline", boom)
     with pytest.raises(ValueError):
         bench._gpt_headline_evidence(8, 1024, 10)
+
+
+def test_o0_evidence_success(monkeypatch):
+    """The fresh-process fp32 leg returns stats + the batch it landed at
+    (the parent states both batches when computing the per-token ratio)."""
+    monkeypatch.setattr(bench, "measure_resilient",
+                        lambda *a, **k: ([40.0, 41.0, 42.0], 4))
+    frag, errs = bench._gpt_o0_evidence(8, 1024, 10)
+    assert errs == {}
+    assert frag["o0"]["median"] == 41.0
+    assert frag["o0"]["batch"] == 4
+
+
+def test_o0_evidence_records_oom(monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("O0: OOM even at batch 1; last: RESOURCE_EXHAUSTED")
+
+    monkeypatch.setattr(bench, "measure_resilient", boom)
+    frag, errs = bench._gpt_o0_evidence(8, 1024, 10)
+    assert frag == {}
+    assert "o0_baseline" in errs
+
+
+def test_o0_evidence_reraises_non_oom(monkeypatch):
+    def boom(*a, **k):
+        raise ValueError("a real bug, not memory pressure")
+
+    monkeypatch.setattr(bench, "measure_resilient", boom)
+    with pytest.raises(ValueError):
+        bench._gpt_o0_evidence(8, 1024, 10)
 
 
 def test_degraded_evidence_falls_to_smaller_rung(monkeypatch):

@@ -33,7 +33,10 @@ def test_run_grid_writes_artifacts(tmp_path):
     per_config = sorted(p.name for p in tmp_path.glob("scaling_dp*_l2.json"))
     assert per_config == ["scaling_dp1_tp1_pp2_l2.json", "scaling_dp2_tp1_pp1_l2.json"]
     table = json.loads((tmp_path / "scaling_table.json").read_text())
-    for row in table:
+    # reading-guide notes travel WITH the artifact (VERDICT r4 weak #5:
+    # CPU-mesh tokens/s must not be read as scaling efficiency)
+    assert "NOT a scaling-efficiency" in table["notes"]["reading_guide"]
+    for row in table["rows"]:
         assert "skipped" in row or row["tokens_per_sec"] > 0
         assert row["config"]["layers"] == 2
 
