@@ -59,6 +59,10 @@ class BertConfig:
     remat: bool = True
     add_binary_head: bool = True
     attention_impl: str = "auto"
+    # unrolled layer drive (same stacked params, static per-layer slices):
+    # avoids the layer scan's dynamic-update-slice grad stacking — see
+    # GPTConfig.unroll_layers and PERF_NOTES r5
+    unroll_layers: bool = False
     # sequence (context) parallelism over this mesh axis — the shared
     # TransformerBase._attend ring/Ulysses path (bidirectional here).
     # Padding attention_masks work: they become segment ids whose kv

@@ -393,6 +393,43 @@ def _device_trace_events(log_dir: str):
                 yield e
 
 
+def _accumulate_events(events, scope_of, *, steps, depth):
+    """Pure accumulation step of the trace join: sum device durations per
+    named_scope stack and per HLO instruction family. Control-flow
+    ENVELOPE events (``while``/``conditional``/``call``) are dropped —
+    the TPU trace also carries each body instruction individually, so
+    counting the envelope bills a scanned layer stack twice (measured:
+    the while event ≈ the sum of its body rows, inflating
+    ``<total_device>`` ~2x)."""
+    acc: Dict[str, float] = {}
+    kinds: Dict[str, float] = {}
+    total = 0.0
+    for e in events:
+        dur_ps = e.get("args", {}).get("device_duration_ps")
+        name = e.get("name", "").lstrip("%")
+        if dur_ps is None or name not in scope_of:
+            continue  # whole-program envelope events etc.
+        if name.split(".")[0] in ("while", "conditional", "call"):
+            continue  # control-flow envelope (see docstring)
+        # drop STRUCTURAL stack components (scan/cond plumbing) so the
+        # semantic scopes (attention, mlp, ...) — which sit inside the
+        # layer scan's while/body — survive depth truncation, while
+        # the jvp()/transpose() prefix keeps fwd and bwd distinct
+        parts = [c for c in (scope_of[name] or "").split("/")
+                 if c and c not in _STRUCTURAL_SCOPES]
+        scope_path = "/".join(parts) or "<unscoped>"
+        if depth is not None:
+            scope_path = "/".join(scope_path.split("/")[:depth])
+        sec = float(dur_ps) * 1e-12 / steps
+        acc[scope_path] = acc.get(scope_path, 0.0) + sec
+        kind = name.split(".")[0].rstrip("0123456789_")
+        kinds[kind] = kinds.get(kind, 0.0) + sec
+        total += sec
+    acc["<total_device>"] = total
+    kinds["<total_device>"] = total
+    return acc, kinds
+
+
 def _measured_join(fn, *args, steps, depth, **kwargs):
     """Shared trace-capture + HLO-metadata join behind the measured_*
     functions. Returns ``(scope_seconds, kind_seconds)`` where scopes are
@@ -424,38 +461,9 @@ def _measured_join(fn, *args, steps, depth, **kwargs):
             # leave the profiler open (every later start_trace in this
             # process would fail) or writing into a deleted directory
             jax.profiler.stop_trace()
-        acc: Dict[str, float] = {}
-        kinds: Dict[str, float] = {}
-        total = 0.0
-        for e in _device_trace_events(log_dir):
-            dur_ps = e.get("args", {}).get("device_duration_ps")
-            name = e.get("name", "").lstrip("%")
-            if dur_ps is None or name not in scope_of:
-                continue  # whole-program envelope events etc.
-            if name.split(".")[0] in ("while", "conditional", "call"):
-                # control-flow ENVELOPE events: the TPU trace also carries
-                # each body instruction individually, so counting the
-                # envelope bills the loop body twice (measured: a scanned
-                # layer stack's while event ≈ the sum of its body rows,
-                # inflating <total_device> ~2x)
-                continue
-            # drop STRUCTURAL stack components (scan/cond plumbing) so the
-            # semantic scopes (attention, mlp, ...) — which sit inside the
-            # layer scan's while/body — survive depth truncation, while
-            # the jvp()/transpose() prefix keeps fwd and bwd distinct
-            parts = [c for c in (scope_of[name] or "").split("/")
-                     if c and c not in _STRUCTURAL_SCOPES]
-            scope_path = "/".join(parts) or "<unscoped>"
-            if depth is not None:
-                scope_path = "/".join(scope_path.split("/")[:depth])
-            sec = float(dur_ps) * 1e-12 / steps
-            acc[scope_path] = acc.get(scope_path, 0.0) + sec
-            kind = name.split(".")[0].rstrip("0123456789_")
-            kinds[kind] = kinds.get(kind, 0.0) + sec
-            total += sec
-        acc["<total_device>"] = total
-        kinds["<total_device>"] = total
-        return acc, kinds
+        return _accumulate_events(
+            _device_trace_events(log_dir), scope_of, steps=steps,
+            depth=depth)
     finally:
         shutil.rmtree(log_dir, ignore_errors=True)
 

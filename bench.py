@@ -131,7 +131,7 @@ def _oom_halving(run, batch, *, min_batch, label):
 
 
 def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
-          layers=None):
+          layers=None, unroll=False):
     import optax
 
     from apex_tpu import amp
@@ -151,6 +151,9 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
         remat=True,
         remat_policy=remat_policy,
         attention_impl=impl,
+        # unrolled layer drive kills the scan backward's ~28 ms of grad
+        # stacking (PERF_NOTES r5); ladder falls back to scan under OOM
+        unroll_layers=unroll,
         # fused chunked LM-head CE: ~6% throughput and ~0.8 GB less peak HBM
         # (survives pressure from co-tenants on the shared chip) — PERF_NOTES.md
         lm_head_chunks=8 if fused else None,
@@ -230,17 +233,25 @@ def _prepare(step, params, opt_state, batch, seq, steps=10, scan_chunk=4):
 
 
 _LADDERS = {
-    # (remat_policy, scan_chunk) from fastest to most memory-frugal.
+    # (remat_policy, scan_chunk, unroll_layers) from fastest to most
+    # memory-frugal. The unroll rung drives the stacked layers with static
+    # slices instead of lax.scan: the scan backward's dynamic-update-slice
+    # grad stacking cost ~28 ms of the 345M grad step (230 -> 188 ms
+    # measured on-chip, PERF_NOTES r5); under unroll prevent_cse also lets
+    # XLA elide remat recompute where memory allows, so full remat leads.
     # save_attn keeps the flash kernel outputs so backward skips the
     # attention recompute (~5% when HBM allows it); scan 8 amortizes
     # another ~1-1.5% of dispatch/carry cost over scan 4 (A/B/A bracket:
     # 30.6k vs 30.1-30.4k tok/s same session) at the price of a larger
     # program for the first rung.
-    # Both ladders lead with scan 8 so the O2/O0 ratio compares like with
-    # like — an asymmetric chunk size would inflate vs_baseline by the
-    # harness's own amortization, not the optimizations under test.
-    "O2": [("save_attn", 8), ("save_attn", 4), (None, 4), (None, 1)],
-    "O0": [(None, 8), (None, 4), (None, 1)],
+    # Both ladders lead with the SAME (unroll, scan 8) harness so the
+    # O2/O0 ratio compares like with like — an asymmetric drive would
+    # inflate vs_baseline by the harness's own amortization, not the
+    # optimizations under test.
+    "O2": [(None, 8, True), ("save_attn", 8, False), ("save_attn", 4, False),
+           (None, 4, False), (None, 1, False)],
+    "O0": [(None, 8, True), (None, 8, False), (None, 4, False),
+           (None, 1, False)],
 }
 
 
@@ -260,10 +271,11 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
     attempt = 0
     last_oom = ""
     while True:
-        for remat_policy, scan_chunk in _LADDERS[level]:
+        for remat_policy, scan_chunk, unroll in _LADDERS[level]:
             try:
                 prep = _prepare(
-                    *build(level, impl, remat_policy, hidden, layers),
+                    *build(level, impl, remat_policy, hidden, layers,
+                           unroll=unroll),
                     batch, seq, steps, scan_chunk=scan_chunk)
                 return prep + (batch,)
             except Exception as e:  # noqa: BLE001 - jaxlib error types vary
@@ -277,7 +289,8 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
                 del e
                 gc.collect()
                 print(f"{level}: OOM at remat_policy={remat_policy} "
-                      f"scan={scan_chunk}, batch {batch}", file=sys.stderr)
+                      f"scan={scan_chunk} unroll={unroll}, batch {batch}",
+                      file=sys.stderr)
         if batch <= min_batch:
             if attempt < retries:
                 attempt += 1
@@ -736,10 +749,13 @@ def _profile_345m(batch, seq, steps=3):
     from apex_tpu.pyprof.prof import _measured_join
 
     errs = {}
-    for remat_policy, b in (("save_attn", batch), (None, batch),
-                            (None, max(batch // 2, 1))):
+    for remat_policy, b, unroll in ((None, batch, True),
+                                    ("save_attn", batch, False),
+                                    (None, batch, False),
+                                    (None, max(batch // 2, 1), False)):
         try:
-            step, params, opt_state = build("O2", "auto", remat_policy)
+            step, params, opt_state = build("O2", "auto", remat_policy,
+                                            unroll=unroll)
             tokens = jax.random.randint(jax.random.PRNGKey(1), (b, seq),
                                         0, 50304)
             targets = jnp.roll(tokens, -1, axis=-1)
@@ -765,7 +781,7 @@ def _profile_345m(batch, seq, steps=3):
             # an error once a later rung delivered the profile
             return {
                 "model": label, "batch": b, "seq": seq,
-                "remat": remat_policy or "full",
+                "remat": remat_policy or "full", "unroll": unroll,
                 "dispatch_mode": "single_step",
                 "total_ms": round(total * 1e3, 3),
                 "scopes_ms": {k: round(v * 1e3, 3) for k, v in top.items()},
@@ -777,8 +793,8 @@ def _profile_345m(batch, seq, steps=3):
             if not _is_oom(e):
                 raise
             errs["pyprof_345m"] = str(e)[:200]
-            print(f"profile_345m: OOM at remat={remat_policy} b={b}",
-                  file=sys.stderr)
+            print(f"profile_345m: OOM at remat={remat_policy} b={b} "
+                  f"unroll={unroll}", file=sys.stderr)
             gc.collect()
     return None, errs
 
@@ -805,21 +821,28 @@ def _gpt_headline_evidence(batch, seq, steps):
             raise
         errs["headline"] = str(e)[:300]
         print(f"headline FAILED: {e}", file=sys.stderr)
-    if "value" in frag:
-        # measured scope/kind attribution of the step just benchmarked —
-        # in this subprocess because it owns the chip (the parent's HBM
-        # view is polluted by its own stages)
-        import gc
+    return frag, errs
 
-        gc.collect()
-        try:
-            prof, perrs = _profile_345m(frag.get("effective_batch", batch),
-                                        seq)
-            errs.update(perrs)
-            if prof is not None:
-                frag["pyprof_scope_seconds"] = prof
-        except Exception as e:  # noqa: BLE001 - profiling must not cost
-            errs["pyprof_345m"] = str(e)[:200]  # the headline its record
+
+def _gpt_profile_evidence(batch, seq, steps):
+    """The 345M measured profile in its OWN fresh process. Running it at
+    the tail of the headline subprocess OOM'd under pressure even though
+    the headline itself fit — by then that process had churned through
+    the O2 prep plus every failed O0 ladder rung, and a long process
+    cannot allocate what a fresh one can (PERF_NOTES r4: below-Python HBM
+    accumulation through the tunnel). Returns ``(frag, errors)``."""
+    frag, errs = {}, {}
+    try:
+        prof, perrs = _profile_345m(batch, seq)
+        errs.update(perrs)
+        if prof is not None:
+            frag["pyprof_scope_seconds"] = prof
+            print(f"pyprof_345m: total {prof['total_ms']} ms",
+                  file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        if not _is_oom(e):
+            raise
+        errs["pyprof_345m"] = str(e)[:200]
     return frag, errs
 
 
@@ -991,6 +1014,19 @@ def main():
         if "vs_baseline" in d:
             result["vs_baseline_degraded"] = d["vs_baseline"]
 
+        # measured profile of the real 345M step, in a FRESH process (a
+        # churned one cannot allocate what a fresh one can — see
+        # _gpt_profile_evidence)
+        if "value" in result and result.get("value") is not None:
+            try:
+                # seed at the headline's EFFECTIVE batch so the profile
+                # attributes the step that was actually benchmarked
+                run_sub("--gpt-profile", timeout=1200,
+                        env={"BENCH_BATCH":
+                             str(result.get("effective_batch", batch))})
+            except Exception as e:  # noqa: BLE001
+                errors["pyprof_345m_subprocess"] = str(e)[:200]
+
         print(f"platform: {jax.default_backend()}", file=sys.stderr)
 
         # 1. compiled-kernel numerics: tiny footprint, highest evidence value
@@ -1077,11 +1113,12 @@ if __name__ == "__main__":
     if "--selftest" in sys.argv:
         print(json.dumps({"selftest": selftest()}))
     elif ("--gpt-headline" in sys.argv or "--gpt-degraded" in sys.argv
-          or "--gpt-o0" in sys.argv):
+          or "--gpt-o0" in sys.argv or "--gpt-profile" in sys.argv):
         # the subprocess entries main() spawns for the GPT phases (fresh
         # process = fresh HBM through the tunnel)
         fn = (_gpt_headline_evidence if "--gpt-headline" in sys.argv
               else _gpt_o0_evidence if "--gpt-o0" in sys.argv
+              else _gpt_profile_evidence if "--gpt-profile" in sys.argv
               else _gpt_degraded_evidence)
         frag, errs = fn(int(os.environ.get("BENCH_BATCH", "8")), 1024,
                         int(os.environ.get("BENCH_STEPS", "10")))

@@ -50,6 +50,26 @@ def test_bert_forward_shapes_and_loss():
     assert 3.0 < float(loss) < 7.0
 
 
+def test_bert_unroll_matches_scan():
+    """unroll_layers must preserve the bidirectional path with a real
+    padding mask (attn_bias rides the unrolled body's closure) — loss and
+    grads match the lax.scan drive."""
+    scan_m = BertModel(BertConfig(axis=None, **TINY))
+    unroll_m = BertModel(BertConfig(axis=None, unroll_layers=True, **TINY))
+    params = scan_m.init(jax.random.PRNGKey(0))
+    toks, attn, lmask, labels, nsp = _batch(jax.random.PRNGKey(1))
+
+    def loss(m):
+        return lambda p: m.loss(p, toks, attn, lmask, labels, nsp)
+
+    l_s, g_s = jax.value_and_grad(loss(scan_m))(params)
+    l_u, g_u = jax.value_and_grad(loss(unroll_m))(params)
+    np.testing.assert_allclose(float(l_s), float(l_u), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_u)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_bert_padding_mask_matters():
     """Attention must ignore padded keys: changing a masked-out token's
     content must not change unmasked positions' logits."""

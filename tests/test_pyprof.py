@@ -185,6 +185,37 @@ def test_hlo_scope_map_parses_compiled_metadata():
     assert any("mlp" in s for s in scopes), scopes
 
 
+def test_accumulate_events_drops_control_flow_envelopes():
+    """The TPU device trace carries BOTH a while/conditional envelope
+    event and each body instruction; counting both double-bills the loop
+    body (observed ~2x on the scanned GPT layer stack). The accumulator
+    must keep the body rows and drop the envelope."""
+    from apex_tpu.pyprof.prof import _accumulate_events
+
+    scope_of = {
+        "while.1": "jvp()",
+        "fusion.1": "jvp()/attention",
+        "fusion.2": "jvp()/mlp",
+        "conditional.3": "jvp()",
+        "call.4": "jvp()",
+    }
+    ps = int(1e12)  # 1 second
+    events = [
+        {"name": "while.1", "args": {"device_duration_ps": 2 * ps}},
+        {"name": "fusion.1", "args": {"device_duration_ps": ps}},
+        {"name": "fusion.2", "args": {"device_duration_ps": ps}},
+        {"name": "conditional.3", "args": {"device_duration_ps": ps}},
+        {"name": "call.4", "args": {"device_duration_ps": ps}},
+        {"name": "unknown.9", "args": {"device_duration_ps": ps}},  # unjoined
+        {"name": "fusion.1", "args": {}},  # no duration
+    ]
+    scopes, kinds = _accumulate_events(events, scope_of, steps=1, depth=2)
+    assert scopes["<total_device>"] == pytest.approx(2.0)  # body only
+    assert scopes["jvp()/attention"] == pytest.approx(1.0)
+    assert "while" not in kinds and "conditional" not in kinds
+    assert kinds["fusion"] == pytest.approx(2.0)
+
+
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="device traces only exist on TPU")
 def test_measured_scope_seconds_on_tpu():
