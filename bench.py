@@ -264,7 +264,10 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
     tunnel, buffer frees land asynchronously and co-tenant spikes pass
     within tens of seconds (both observed live in r4: a config that OOM'd
     at batch 1 ran at 64k tok/s in the same process minutes later).
-    Returns ``(advance, get_loss, n_chunks, units, state, batch)``."""
+    Returns ``(advance, get_loss, n_chunks, units, state, batch, rung)``
+    where ``rung`` records which ladder configuration actually ran (the
+    BENCH record must show whether the unroll rung or a fallback
+    produced each number)."""
     import gc
 
     batch0 = batch
@@ -277,7 +280,9 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
                     *build(level, impl, remat_policy, hidden, layers,
                            unroll=unroll),
                     batch, seq, steps, scan_chunk=scan_chunk)
-                return prep + (batch,)
+                return prep + (batch, {"remat": remat_policy or "full",
+                                       "scan": scan_chunk,
+                                       "unroll": unroll})
             except Exception as e:  # noqa: BLE001 - jaxlib error types vary
                 if not _is_oom(e):
                     raise
@@ -314,13 +319,14 @@ def measure_resilient(level, impl, batch, seq, steps, windows=WINDOWS,
     import gc
 
     while True:
-        advance, get_loss, n_chunks, units, _state, batch = prepare_resilient(
+        (advance, get_loss, n_chunks, units, _state, batch,
+         rung) = prepare_resilient(
             level, impl, batch, seq, steps, hidden=hidden, layers=layers,
             retries=retries, retry_sleep=retry_sleep)
         try:
             rates = _timed_windows(advance, get_loss, steps=n_chunks,
                                    windows=windows, per_window_units=units)
-            return rates, batch
+            return rates, batch, rung
         except Exception as e:  # noqa: BLE001
             if not _is_oom(e) or batch <= 1:
                 raise
@@ -346,13 +352,14 @@ def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
     headline, VERDICT r3 ask #1)."""
     prep2 = prepare_resilient("O2", "auto", batch, seq, steps,
                               hidden=hidden, layers=layers)
-    b2 = prep2[-1]
+    b2, rung2 = prep2[-2], prep2[-1]
     # time the headline VALUE first, before any baseline attempt can churn
     # HBM (observed: the O0-345M fp32 leg can be unplaceable for minutes
     # while O2 bf16 runs fine)
-    solo2 = _stats(_timed_windows(prep2[0], prep2[1], steps=prep2[2],
-                                  windows=windows,
-                                  per_window_units=prep2[3]))
+    solo2 = dict(_stats(_timed_windows(prep2[0], prep2[1], steps=prep2[2],
+                                       windows=windows,
+                                       per_window_units=prep2[3])),
+                 rung=rung2)
     interleaved = True
     prep0 = None
     try:
@@ -380,15 +387,15 @@ def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
                 # (params + Adam moments): give it extra sleep-retries so
                 # a co-tenant pressure dip within ~2 minutes still yields
                 # a ratio instead of a value-only record
-                rates0, b0 = measure_resilient("O0", "xla", b, seq, steps,
-                                               windows, hidden=hidden,
-                                               layers=layers, retries=2,
-                                               retry_sleep=45)
-                rates2, b = measure_resilient("O2", "auto", b0, seq, steps,
-                                              windows, hidden=hidden,
-                                              layers=layers)
+                rates0, b0, rung0 = measure_resilient(
+                    "O0", "xla", b, seq, steps, windows, hidden=hidden,
+                    layers=layers, retries=2, retry_sleep=45)
+                rates2, b, rung2b = measure_resilient(
+                    "O2", "auto", b0, seq, steps, windows, hidden=hidden,
+                    layers=layers)
                 if b == b0:
-                    return _stats(rates2), _stats(rates0), b, False
+                    return (dict(_stats(rates2), rung=rung2b),
+                            dict(_stats(rates0), rung=rung0), b, False)
         except Exception as e:  # noqa: BLE001
             if not _is_oom(e):
                 raise
@@ -398,10 +405,11 @@ def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
     # min_batch=b2 on the co-resident prepare means success implies the
     # same batch; the unequal-batch case always goes through the
     # sequential fallback above
-    assert prep0[-1] == b2, (prep0[-1], b2)
+    assert prep0[-2] == b2, (prep0[-2], b2)
     b0 = b2
-    adv2, loss2, n2, u2, _s2, _ = prep2
-    adv0, loss0, n0, u0, _s0, _ = prep0
+    rung0 = prep0[-1]
+    adv2, loss2, n2, u2, _s2, _, _ = prep2
+    adv0, loss0, n0, u0, _s0, _, _ = prep0
     rates2, rates0 = [], []
     try:
         for _ in range(windows):
@@ -423,7 +431,8 @@ def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
         rates2, rates0 = rates2[:n], rates0[:n]
         print(f"headline: OOM mid-interleave after {n} paired windows; "
               "reporting the completed pairs", file=sys.stderr)
-    return _stats(rates2), _stats(rates0), b2, interleaved
+    return (dict(_stats(rates2), rung=rung2),
+            dict(_stats(rates0), rung=rung0), b2, interleaved)
 
 
 def _canary(windows=3):
@@ -536,37 +545,50 @@ def bench_resnet50(batch=None, steps=10, windows=WINDOWS):
 
 
 def bench_bert_lamb(batch=None, steps=10, windows=WINDOWS):
+    import gc
+
     from apex_tpu import amp
     from apex_tpu.models import BertConfig, BertModel
     from apex_tpu.optimizers import FusedLAMB
 
     batch = batch or int(os.environ.get("BENCH_BERT_BATCH", "8"))
     seq = 512
-    cfg = BertConfig(
-        vocab_size=30592, hidden_size=1024, num_layers=24,
-        num_attention_heads=16, max_seq_len=seq, hidden_dropout=0.0,
-        axis=None, compute_dtype=jnp.bfloat16, remat=True)
-    model = BertModel(cfg)
-    policy = amp.get_policy("O2")
-    mp_opt = amp.MixedPrecisionOptimizer(FusedLAMB(lr=1e-3), policy)
-    params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
-    opt_state = mp_opt.init(params)
 
-    @jax.jit
-    def step(params, opt_state, toks, lmask, labels, nsp):
-        def scaled_loss(p):
-            return mp_opt.scale_loss(
-                model.loss(p, toks, None, lmask, labels, nsp), opt_state)
+    def build_step(unroll):
+        cfg = BertConfig(
+            vocab_size=30592, hidden_size=1024, num_layers=24,
+            num_attention_heads=16, max_seq_len=seq, hidden_dropout=0.0,
+            axis=None, compute_dtype=jnp.bfloat16, remat=True,
+            unroll_layers=unroll)
+        model = BertModel(cfg)
+        policy = amp.get_policy("O2")
+        mp_opt = amp.MixedPrecisionOptimizer(FusedLAMB(lr=1e-3), policy)
+        params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+        opt_state = mp_opt.init(params)
 
-        loss_s, grads = jax.value_and_grad(scaled_loss)(params)
-        new_params, new_state, _ = mp_opt.apply_gradients(
-            opt_state, params, grads)
-        return new_params, new_state, loss_s / opt_state.scaler.loss_scale
+        @jax.jit
+        def step(params, opt_state, toks, lmask, labels, nsp):
+            def scaled_loss(p):
+                return mp_opt.scale_loss(
+                    model.loss(p, toks, None, lmask, labels, nsp), opt_state)
 
-    def run(batch):
+            loss_s, grads = jax.value_and_grad(scaled_loss)(params)
+            new_params, new_state, _ = mp_opt.apply_gradients(
+                opt_state, params, grads)
+            return new_params, new_state, loss_s / opt_state.scaler.loss_scale
+
+        return cfg, step, params, opt_state
+
+    def attempt(unroll, batch):
+        """One (config, batch) measurement in its OWN frame, so a failed
+        attempt's ~5 GB of buffers (params + LAMB masters/moments + jitted
+        step) die with the frame before the fallback allocates — the
+        buffer-pinning trap prepare_resilient documents."""
+        cfg, step, params, opt_state = build_step(unroll)
         ks = jax.random.split(jax.random.PRNGKey(1), 4)
         toks = jax.random.randint(ks[0], (batch, seq), 0, cfg.vocab_size)
-        lmask = (jax.random.uniform(ks[1], (batch, seq)) < 0.15).astype(jnp.int32)
+        lmask = (jax.random.uniform(ks[1], (batch, seq))
+                 < 0.15).astype(jnp.int32)
         labels = jax.random.randint(ks[2], (batch, seq), 0, cfg.vocab_size)
         nsp = jax.random.randint(ks[3], (batch,), 0, 2)
         state = [params, opt_state, None]
@@ -579,7 +601,31 @@ def bench_bert_lamb(batch=None, steps=10, windows=WINDOWS):
         rates = _timed_windows(advance, lambda: state[2], steps=steps,
                                windows=windows,
                                per_window_units=batch * seq * steps)
-        return dict(_stats(rates), batch=batch)
+        return dict(_stats(rates), batch=batch, unroll=unroll)
+
+    def run(batch):
+        # mini-ladder mirroring _LADDERS' shape: the unrolled drive first
+        # (kills the layer scan's grad-stacking DUS), scan fallback at the
+        # SAME batch before the outer halving shrinks it
+        last_msg = ""
+        for unroll in (True, False):
+            try:
+                return attempt(unroll, batch)
+            except Exception as e:  # noqa: BLE001
+                if not _is_oom(e):
+                    raise
+                # keep only a STRING (the exception's traceback pins the
+                # failed attempt's device buffers)
+                last_msg = str(e)[:300]
+                del e
+                gc.collect()
+                print(f"bert: OOM at unroll={unroll} batch {batch}",
+                      file=sys.stderr)
+        # phrase with the marker _is_oom matches, so the outer halving
+        # ladder recognizes this as memory pressure even when last_msg's
+        # truncation lost the RESOURCE_EXHAUSTED text
+        raise RuntimeError(
+            f"bert: OOM even at batch {batch}; last: {last_msg}")
 
     return _oom_halving(run, batch, min_batch=1, label="bert")
 
@@ -854,9 +900,9 @@ def _gpt_o0_evidence(batch, seq, steps):
     computes the per-token ratio from the two processes' medians."""
     frag, errs = {}, {}
     try:
-        rates, b0 = measure_resilient("O0", "xla", batch, seq, steps,
-                                      retries=2, retry_sleep=45)
-        frag["o0"] = dict(_stats(rates), batch=b0)
+        rates, b0, rung0 = measure_resilient("O0", "xla", batch, seq, steps,
+                                             retries=2, retry_sleep=45)
+        frag["o0"] = dict(_stats(rates), batch=b0, rung=rung0)
         print(f"o0 baseline: {frag['o0']}", file=sys.stderr)
     except Exception as e:  # noqa: BLE001
         if not _is_oom(e):
@@ -913,6 +959,22 @@ def main():
     }
     errors = {}
 
+    def checkpoint():
+        """Persist the partial record after every stage: when the tunnel
+        WEDGES (observed r5: even a 4k matmul never returns — no
+        exception, nothing to catch), the watchdog parent kills this
+        process and prints the last checkpoint instead of nothing."""
+        path = os.environ.get("BENCH_PARTIAL_PATH")
+        if path:
+            rec = dict(result)
+            if errors:
+                rec["errors"] = dict(errors)
+            try:
+                with open(path, "w") as f:
+                    json.dump(rec, f)
+            except OSError:
+                pass
+
     def stage(key, fn):
         """Run one evidence stage; on failure record the error and move on.
         gc between stages so a finished (or failed) stage's device buffers
@@ -929,6 +991,7 @@ def main():
             return None
         finally:
             gc.collect()
+            checkpoint()
 
     try:
         # 0. the GPT headline — FIRST, each phase in a FRESH SUBPROCESS
@@ -943,6 +1006,12 @@ def main():
         def run_sub(flag, update=True, timeout=2700, env=None):
             import subprocess
 
+            # stay inside the watchdog's budget: finishing early with
+            # this phase marked failed beats being killed mid-stage with
+            # the later phases silently dropped
+            deadline_at = float(os.environ.get("BENCH_DEADLINE_AT", "inf"))
+            remaining = deadline_at - time.time() - 120
+            timeout = max(60, min(timeout, remaining))
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), flag],
                 capture_output=True, text=True, timeout=timeout,
@@ -1026,6 +1095,7 @@ def main():
                              str(result.get("effective_batch", batch))})
             except Exception as e:  # noqa: BLE001
                 errors["pyprof_345m_subprocess"] = str(e)[:200]
+        checkpoint()
 
         print(f"platform: {jax.default_backend()}", file=sys.stderr)
 
@@ -1109,6 +1179,78 @@ def main():
     sys.exit(0)
 
 
+def _watchdog(cmd=None, env_extra=None):
+    """Run ``main()`` in a CHILD process under a hard deadline and print
+    ITS json line — or, if the child hangs past the deadline, kill it and
+    print the partial record it checkpointed after every stage.
+
+    Why: the r5 sessions showed a failure mode the stage wrappers cannot
+    catch — the tunnel WEDGES and a device call simply never returns (a
+    4096^2 matmul probe sat for 10+ minutes; no OOM, no exception). Under
+    that regime the old main() would hang mid-stage and the round would
+    end with no JSON line at all. The subprocess phases already carry
+    their own timeouts; this covers the parent's in-process stages.
+    ``cmd``/``env_extra`` exist for the unit test (a stub child)."""
+    import signal
+    import subprocess
+    import tempfile
+
+    # must exceed the worst-case SUM of the child's own subprocess
+    # timeouts (headline 2700 + degraded 2700 + o0 1800 + profile 1200 =
+    # 8400 s) plus the in-process stages — a retry-heavy but HEALTHY round
+    # must not be killed mid-stage. run_sub additionally caps each
+    # subprocess timeout to the remaining budget via BENCH_DEADLINE_AT.
+    deadline = int(os.environ.get("BENCH_DEADLINE", "10800"))
+    fd, partial = tempfile.mkstemp(prefix="bench_partial_", suffix=".json")
+    os.close(fd)
+    env = dict(os.environ, BENCH_WATCHDOG="0", BENCH_PARTIAL_PATH=partial,
+               BENCH_DEADLINE_AT=str(time.time() + deadline))
+    env.update(env_extra or {})
+    cmd = cmd or [sys.executable, os.path.abspath(__file__)]
+    # own session/process group: on timeout the WHOLE tree dies — the
+    # wedged device call usually lives in a run_sub grandchild, which a
+    # bare proc.kill() would orphan, leaving it pinning the chip
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True, env=env,
+                            start_new_session=True)
+
+    def recover(reason):
+        try:
+            with open(partial) as f:
+                rec = json.load(f)
+        except Exception:  # noqa: BLE001 - nothing checkpointed yet
+            rec = {"metric": "gpt2_345m_o2_train_tokens_per_sec",
+                   "value": None, "unit": "tokens/s", "vs_baseline": None}
+        rec.setdefault("errors", {})["watchdog"] = (
+            reason + "; printing the last per-stage checkpoint")
+        print(json.dumps(rec))
+        return 0
+
+    try:
+        try:
+            out, _ = proc.communicate(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            proc.wait()
+            return recover(f"deadline {deadline}s exceeded (wedged "
+                           "tunnel?)")
+        lines = (out or "").strip().splitlines()
+        if lines and lines[-1].lstrip().startswith("{"):
+            sys.stdout.write(out)
+            return 0
+        # the child DIED without a record (segfault/abort in the native
+        # plugin — same failure family as the wedge): recover the partial
+        return recover(f"child exited rc={proc.returncode} with no JSON "
+                       "line")
+    finally:
+        try:
+            os.unlink(partial)
+        except OSError:
+            pass
+
+
 if __name__ == "__main__":
     if "--selftest" in sys.argv:
         print(json.dumps({"selftest": selftest()}))
@@ -1125,5 +1267,7 @@ if __name__ == "__main__":
         if errs:
             frag["errors"] = errs
         print(json.dumps(frag))
+    elif os.environ.get("BENCH_WATCHDOG", "1") != "0":
+        sys.exit(_watchdog())
     else:
         main()

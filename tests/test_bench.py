@@ -79,15 +79,81 @@ def test_headline_evidence_reraises_non_oom(monkeypatch):
         bench._gpt_headline_evidence(8, 1024, 10)
 
 
+def test_watchdog_passes_through_child_json(monkeypatch, capsys):
+    """A healthy child's JSON line is printed verbatim."""
+    # -S skips sitecustomize (which imports the axon plugin and takes
+    # seconds) so the stub children start fast enough to beat the deadline
+    code = "import json; print(json.dumps({'value': 42}))"
+    monkeypatch.setenv("BENCH_DEADLINE", "30")
+    rc = bench._watchdog(cmd=[sys.executable, "-S", "-c", code])
+    assert rc == 0
+    assert '"value": 42' in capsys.readouterr().out
+
+
+def test_watchdog_prints_partial_on_hang(monkeypatch, capsys):
+    """A WEDGED child (the r5 tunnel regime: device calls never return)
+    is killed at the deadline and its last per-stage checkpoint is
+    printed with a watchdog error — the JSON line survives no matter
+    what."""
+    import json as _json
+
+    code = (
+        "import json, os, time\n"
+        "with open(os.environ['BENCH_PARTIAL_PATH'], 'w') as f:\n"
+        "    json.dump({'value': 7.0, 'metric': 'm'}, f)\n"
+        "time.sleep(60)\n"
+    )
+    monkeypatch.setenv("BENCH_DEADLINE", "5")
+    rc = bench._watchdog(cmd=[sys.executable, "-S", "-c", code])
+    assert rc == 0
+    rec = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 7.0
+    assert "watchdog" in rec["errors"]
+
+
+def test_watchdog_recovers_partial_on_child_crash(monkeypatch, capsys):
+    """A child that DIES with no stdout (segfault/abort in the native
+    plugin) must not end the round with no JSON line — the partial
+    checkpoint is recovered exactly as in the hang case."""
+    import json as _json
+
+    code = (
+        "import json, os, sys\n"
+        "with open(os.environ['BENCH_PARTIAL_PATH'], 'w') as f:\n"
+        "    json.dump({'value': 9.0, 'metric': 'm'}, f)\n"
+        "os._exit(134)\n"  # simulated SIGABRT death, nothing printed
+    )
+    monkeypatch.setenv("BENCH_DEADLINE", "30")
+    rc = bench._watchdog(cmd=[sys.executable, "-S", "-c", code])
+    assert rc == 0
+    rec = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 9.0
+    assert "no JSON line" in rec["errors"]["watchdog"]
+
+
+def test_watchdog_hang_before_any_checkpoint(monkeypatch, capsys):
+    import json as _json
+
+    monkeypatch.setenv("BENCH_DEADLINE", "2")
+    rc = bench._watchdog(
+        cmd=[sys.executable, "-S", "-c", "import time; time.sleep(30)"])
+    assert rc == 0
+    rec = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert "watchdog" in rec["errors"]
+
+
 def test_o0_evidence_success(monkeypatch):
     """The fresh-process fp32 leg returns stats + the batch it landed at
     (the parent states both batches when computing the per-token ratio)."""
+    rung = {"remat": "full", "scan": 8, "unroll": True}
     monkeypatch.setattr(bench, "measure_resilient",
-                        lambda *a, **k: ([40.0, 41.0, 42.0], 4))
+                        lambda *a, **k: ([40.0, 41.0, 42.0], 4, rung))
     frag, errs = bench._gpt_o0_evidence(8, 1024, 10)
     assert errs == {}
     assert frag["o0"]["median"] == 41.0
     assert frag["o0"]["batch"] == 4
+    assert frag["o0"]["rung"] == rung  # the record shows WHICH rung ran
 
 
 def test_o0_evidence_records_oom(monkeypatch):
