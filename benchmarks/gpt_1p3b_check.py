@@ -117,7 +117,8 @@ def main() -> None:
 
     rel = abs(piped_loss - serial_loss) / max(abs(serial_loss), 1e-9)
     record = {
-        "metric": "gpt_1p3b_width_tp_pp_loss_match",
+        "metric": f"gpt_h{args.hidden}_L{args.layers}_tp{args.tp}"
+                  f"_pp{args.pp}_vpp{args.vpp}_loss_match",
         "hidden": args.hidden, "heads": args.heads, "layers": args.layers,
         "seq": args.seq, "tp": args.tp, "pp": args.pp, "vpp": args.vpp,
         "serial_loss": round(serial_loss, 6),

@@ -59,6 +59,10 @@ def parse_args():
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--opt-level", default="O2")
+    p.add_argument("--unroll", action="store_true",
+                   help="drive the layer stack with static slices instead "
+                        "of lax.scan (kills the scan backward's grad "
+                        "stacking, PERF_NOTES r5; compile time O(depth))")
     p.add_argument("--data", default=None, help="dir of .bin int32 token files")
     p.add_argument("--save-dir", default=None)
     p.add_argument("--save-every", type=int, default=100)
@@ -87,6 +91,7 @@ def main():
         axis=mesh_lib.AXIS_MODEL if args.tp > 1 else None,
         compute_dtype=jnp.bfloat16 if args.opt_level in ("O1", "O2", "O3") else jnp.float32,
         remat=True,
+        unroll_layers=args.unroll,
     )
     model = GPTModel(cfg)
     policy = amp.get_policy(args.opt_level)
