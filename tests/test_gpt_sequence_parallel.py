@@ -35,12 +35,19 @@ def _cleanup():
         mesh_lib.destroy_model_parallel()
 
 
-@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
-def test_gpt_context_parallel_matches_serial(sp_impl):
+@pytest.mark.parametrize("sp_impl,unroll", [
+    ("ring", False), ("ulysses", False),
+    # the unrolled layer drive must compose with both sequence-parallel
+    # collectives (ppermute / all_to_all inside a Python loop body
+    # instead of a scanned one)
+    ("ring", True),
+    ("ulysses", True),
+])
+def test_gpt_context_parallel_matches_serial(sp_impl, unroll):
     serial = GPTModel(GPTConfig(axis=None, **TINY))
     par = GPTModel(GPTConfig(
         axis=None, context_axis=mesh_lib.AXIS_CONTEXT,
-        sequence_parallel_impl=sp_impl, **TINY))
+        sequence_parallel_impl=sp_impl, unroll_layers=unroll, **TINY))
     params = serial.init(jax.random.PRNGKey(0))
     toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
     tgt = jnp.roll(toks, -1, axis=-1)
