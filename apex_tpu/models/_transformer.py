@@ -220,15 +220,16 @@ class TransformerBase:
         (SURVEY.md §2.3 row SP: a new capability vs the reference)."""
         c = self.cfg
         ctx = getattr(c, "context_axis", None)
+        win = getattr(c, "attention_window", None)
         seg = bias if isinstance(bias, SegmentMask) else None
         if ctx is None:
             if seg is not None:
                 return flash_attention(
                     q, k, v, segment_ids=(seg.q_seg, seg.kv_seg),
                     pad_id=seg.pad_id, causal=self.causal,
-                    impl=c.attention_impl)
+                    impl=c.attention_impl, window=win)
             return flash_attention(q, k, v, bias=bias, causal=self.causal,
-                                   impl=c.attention_impl)
+                                   impl=c.attention_impl, window=win)
         from apex_tpu.transformer.ring import ring_attention, ulysses_attention
 
         if bias is not None and seg is None:
@@ -250,7 +251,7 @@ class TransformerBase:
                           pad_id=seg.pad_id)
         return impls[impl_name](
             q, k, v, axis=ctx, causal=self.causal, impl=c.attention_impl,
-            **seg_kw)
+            window=win, **seg_kw)
 
     def _mlp(self, p: Params, h: jax.Array) -> jax.Array:
         with jax.named_scope("mlp"):

@@ -59,6 +59,9 @@ class BertConfig:
     remat: bool = True
     add_binary_head: bool = True
     attention_impl: str = "auto"
+    # symmetric sliding-window attention (bidirectional band
+    # [p-w+1, p+w-1]; flash_attention `window` semantics). None = full.
+    attention_window: Optional[int] = None
     # unrolled layer drive (same stacked params, static per-layer slices):
     # avoids the layer scan's dynamic-update-slice grad stacking — see
     # GPTConfig.unroll_layers and PERF_NOTES r5

@@ -64,6 +64,13 @@ class GPTConfig:
     # (models/_transformer._remat_policy)
     remat_policy: Optional[str] = None
     attention_impl: str = "auto"  # flash_attention impl switch
+    # Sliding-window (local) attention: each token attends only its
+    # `attention_window` most recent positions (flash_attention's `window`
+    # semantics). O(s·w) attention cost — the standard long-context pairing
+    # with the streamed kernels; composes with context parallelism (the
+    # window is defined in global positions and rides the ring offsets).
+    # None = full attention. Beyond-reference capability.
+    attention_window: Optional[int] = None
     # Drive the (still stacked) layer params with an unrolled Python loop
     # of static per-layer slices instead of lax.scan. Measured on-chip at
     # 345M: the scan's backward accumulates layer grads through

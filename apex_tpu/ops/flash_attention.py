@@ -74,6 +74,75 @@ def _supported(sq: int, sk: int, d: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _apply_pos_masks(s, causal, window, q_base, k_base):
+    """Causal and/or sliding-window masking of a score block, in GLOBAL
+    positions (``q_base``/``k_base`` are the block's first row/column
+    positions including any ring-attention shard offset, so the window is
+    correct across context-parallel sequence shards).
+
+    ``window=w`` keeps, for each query position p, the keys in
+    ``[p-w+1, p]`` when causal (the Mistral/Longformer sliding-window
+    convention: w attended positions including self) and the symmetric
+    band ``[p-w+1, p+w-1]`` when not. No reference counterpart — the
+    reference's fmha/fused-softmax kernels have no local-attention mode;
+    this is the standard long-context pairing for the streamed kernels
+    (O(s·w) score work instead of O(s²))."""
+    if not causal and window is None:
+        return s
+    q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if causal:
+        s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+    if window is not None:
+        s = jnp.where(q_pos - k_pos >= window, _NEG_INF, s)
+        if not causal:
+            s = jnp.where(k_pos - q_pos >= window, _NEG_INF, s)
+    return s
+
+
+def _dense_pos_masks(s, q_pos, k_pos, causal, window, neg=_NEG_INF):
+    """The XLA-path twin of :func:`_apply_pos_masks` (shared by
+    ``mha_reference`` and the ring's ``_partial_attn_xla``): same causal +
+    window semantics on a dense score tensor with broadcastable position
+    arrays instead of in-kernel iotas."""
+    if causal:
+        s = jnp.where(k_pos > q_pos, neg, s)
+    if window is not None:
+        s = jnp.where(q_pos - k_pos >= window, neg, s)
+        if not causal:
+            s = jnp.where(k_pos - q_pos >= window, neg, s)
+    return s
+
+
+def _window_k_range(lo, hi, qi, blk_q, blk_k, q_off, k_off, causal, window):
+    """Clip the k-block loop range [lo, hi) for a q block under a sliding
+    window: k blocks wholly left of the window's trailing edge (and, when
+    not causal, wholly right of its leading edge) are never computed —
+    the block-skip that makes window cost O(s·w). Floor division keeps
+    the bounds conservative for partially-covered blocks."""
+    if window is None:
+        return lo, hi
+    t = q_off - k_off + qi * blk_q - window + 1  # min valid local k_pos
+    lo = jnp.maximum(lo, t // blk_k)
+    if not causal:
+        u = q_off - k_off + (qi + 1) * blk_q + window - 2  # max valid
+        hi = jnp.clip(u // blk_k + 1, 0, hi)
+    return lo, hi
+
+
+def _window_q_range(lo, hi, ki, blk_q, blk_k, q_off, k_off, causal, window):
+    """The dK/dV-pass mirror of :func:`_window_k_range`: clip the q-block
+    loop range [lo, hi) for a k block."""
+    if window is None:
+        return lo, hi
+    u = k_off - q_off + (ki + 1) * blk_k + window - 2  # max valid local q_pos
+    hi = jnp.clip(u // blk_q + 1, 0, hi)
+    if not causal:
+        t = k_off - q_off + ki * blk_k - window + 1
+        lo = jnp.maximum(lo, t // blk_q)
+    return lo, hi
+
+
 def _seg_mask(s, q_ids, ks_ref, j, blk_k, pad_id):
     """Mask ``s`` (blk_q, blk_k) to -inf where the q/k segment ids differ
     (or the key is padding). ``q_ids`` is the lane-replicated (blk_q, 128)
@@ -113,7 +182,7 @@ def _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, j_meta, j_slice, blk_k,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref,
                 off_ref, o_ref, lse_ref, *, scale, causal, blk_q, blk_k,
-                pad_id):
+                pad_id, window=None):
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
     sk = k_ref.shape[2]
     d = q.shape[-1]
@@ -141,10 +210,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref,
         if qs_ref is not None:
             s = _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, j, j, blk_k,
                                     pad_id, qmin, qmax)
-        if causal:
-            q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = k_off + j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        s = _apply_pos_masks(s, causal, window, q_off + qi * blk_q,
+                             k_off + j * blk_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # fully-masked rows keep m == -inf: exp(s - m) would be exp(0);
         # zero their probabilities so l stays 0 and the output stays 0
@@ -172,6 +239,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref,
         # from its tiling (scaled_upper_triang_masked_softmax.h).
         lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
         nk = jnp.clip(lim, 0, nk)
+    lo, nk = _window_k_range(lo, nk, qi, blk_q, blk_k, q_off, k_off,
+                             causal, window)
     acc, m, l = jax.lax.fori_loop(lo, nk, body, (acc, m0, l0))
     # Fully-masked rows (padding segments, all -inf bias rows) have l == 0.
     l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -188,6 +257,7 @@ def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref, off_ref,
     do_ref, lse_ref, delta_ref, dq_ref, db_ref,
     *, scale, causal, blk_q, blk_k, pad_id, b_bcast, h_bcast, dims,
+    window=None,
 ):
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
@@ -238,10 +308,8 @@ def _bwd_dq_kernel(
         if qs_ref is not None:
             s = _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, j, j, blk_k,
                                     pad_id, qmin, qmax)
-        if causal:
-            q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = k_off + j * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        s = _apply_pos_masks(s, causal, window, q_off + qi * blk_q,
+                             k_off + j * blk_k)
         # fully-masked rows carry lse == -inf; exp(s - lse) would be exp(0)
         p = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(
@@ -260,6 +328,8 @@ def _bwd_dq_kernel(
     if causal:
         lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
         nk = jnp.clip(lim, 0, nk)
+    lo, nk = _window_k_range(lo, nk, qi, blk_q, blk_k, q_off, k_off,
+                             causal, window)
     dq = jax.lax.fori_loop(lo, nk, body, jnp.zeros_like(q))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
@@ -267,7 +337,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, qmm_ref, kmm_ref, bnd_ref,
     off_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, scale, causal, blk_q, blk_k, pad_id,
+    *, scale, causal, blk_q, blk_k, pad_id, window=None,
 ):
     k = k_ref[0, 0].astype(jnp.float32)  # (blk_k, d)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -309,10 +379,8 @@ def _bwd_dkv_kernel(
                 uniform_ok = uniform_ok & (qmin != pad_id)
             s = jax.lax.cond(uniform_ok, lambda s: s,
                              lambda s: seg_mask_dkv(s, i), s)
-        if causal:
-            q_pos = q_off + i * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = k_off + ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        s = _apply_pos_masks(s, causal, window, q_off + i * blk_q,
+                             k_off + ki * blk_k)
         # fully-masked rows carry lse == -inf; exp(s - lse) would be exp(0)
         p = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(s - lse))  # (blk_q, blk_k)
         dv_new = dv + jax.lax.dot_general(
@@ -336,6 +404,8 @@ def _bwd_dkv_kernel(
         # contiguous-segment bounds over q blocks for this k block
         start = jnp.maximum(start, bnd_ref[0, 0, ki])
         nq = jnp.minimum(nq, bnd_ref[0, 1, ki])
+    start, nq = _window_q_range(start, nq, ki, blk_q, blk_k, q_off, k_off,
+                                causal, window)
     dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
@@ -359,7 +429,8 @@ def _bwd_dkv_kernel(
 
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
                        bnd_ref, off_ref, o_ref, lse_ref, acc_ref, m_ref,
-                       l_ref, *, scale, causal, blk_q, blk_k, pad_id, nk):
+                       l_ref, *, scale, causal, blk_q, blk_k, pad_id, nk,
+                       window=None):
     qi = pl.program_id(2)
     kj = pl.program_id(3)
     q_off = off_ref[0] if off_ref is not None else 0
@@ -379,6 +450,8 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
     if causal:
         lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
         hi = jnp.clip(lim, 0, hi)
+    lo, hi = _window_k_range(lo, hi, qi, blk_q, blk_k, q_off, k_off,
+                             causal, window)
 
     @pl.when((kj >= lo) & (kj < hi))
     def _compute():
@@ -395,12 +468,8 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
             qmax = qmm_ref[0, 1, qi]
             s = _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, kj, 0, blk_k,
                                     pad_id, qmin, qmax)
-        if causal:
-            q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = k_off + kj * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        s = _apply_pos_masks(s, causal, window, q_off + qi * blk_q,
+                             k_off + kj * blk_k)
         m = m_ref[...]
         l = l_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -422,7 +491,8 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
 def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
                           qmm_ref, bnd_ref, off_ref, do_ref, lse_ref,
                           delta_ref, dq_ref, dq_acc_ref,
-                          *, scale, causal, blk_q, blk_k, pad_id, nk):
+                          *, scale, causal, blk_q, blk_k, pad_id, nk,
+                          window=None):
     qi = pl.program_id(2)
     kj = pl.program_id(3)
     q_off = off_ref[0] if off_ref is not None else 0
@@ -440,6 +510,8 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
     if causal:
         lim = (q_off - k_off + (qi + 1) * blk_q + blk_k - 1) // blk_k
         hi = jnp.clip(lim, 0, hi)
+    lo, hi = _window_k_range(lo, hi, qi, blk_q, blk_k, q_off, k_off,
+                             causal, window)
 
     @pl.when((kj >= lo) & (kj < hi))
     def _compute():
@@ -457,12 +529,8 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
             qmax = qmm_ref[0, 1, qi]
             s = _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, kj, 0, blk_k,
                                     pad_id, qmin, qmax)
-        if causal:
-            q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = k_off + kj * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        s = _apply_pos_masks(s, causal, window, q_off + qi * blk_q,
+                             k_off + kj * blk_k)
         p = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -479,7 +547,8 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
 def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, qmm_ref,
                            kmm_ref, bnd_ref, off_ref, do_ref, lse_ref,
                            delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
-                           *, scale, causal, blk_q, blk_k, pad_id, nq):
+                           *, scale, causal, blk_q, blk_k, pad_id, nq,
+                           window=None):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     q_off = off_ref[0] if off_ref is not None else 0
@@ -497,6 +566,8 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, qmm_ref,
     if bnd_ref is not None:
         lo = jnp.maximum(lo, bnd_ref[0, 0, ki])
         hi = jnp.minimum(hi, bnd_ref[0, 1, ki])
+    lo, hi = _window_q_range(lo, hi, ki, blk_q, blk_k, q_off, k_off,
+                             causal, window)
 
     @pl.when((qi >= lo) & (qi < hi))
     def _compute():
@@ -517,12 +588,8 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, qmm_ref,
             qmax = qmm_ref[0, 1, qi]
             s = _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, ki, 0,
                                     blk_k, pad_id, qmin, qmax)
-        if causal:
-            q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = k_off + ki * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        s = _apply_pos_masks(s, causal, window, q_off + qi * blk_q,
+                             k_off + ki * blk_k)
         p = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -658,11 +725,11 @@ def _smem_pair_spec(n, reorder=None):
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "causal", "blk_q", "blk_k", "pad_id",
-                     "contiguous", "stream"),
+                     "contiguous", "stream", "window"),
 )
 def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
                scale, causal, blk_q, blk_k, pad_id=None, contiguous=True,
-               stream=False):
+               stream=False, window=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if stream:
@@ -670,7 +737,7 @@ def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
         return _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg,
                                  scale=scale, causal=causal, blk_q=blk_q,
                                  blk_k=blk_k, pad_id=pad_id,
-                                 contiguous=contiguous)
+                                 contiguous=contiguous, window=window)
     grid = (b, h, sq // blk_q)
     qspec = pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM)
@@ -718,7 +785,7 @@ def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
         orf, lr = refs[i], refs[i + 1]
         _fwd_kernel(qr, kr, vr, br, qsr, ksr, kmmr, bndr, offr, orf, lr,
                     scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                    pad_id=pad_id)
+                    pad_id=pad_id, window=window)
 
     o, lse = pl.pallas_call(
         kern,
@@ -741,7 +808,7 @@ def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
 
 
 def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
-                      blk_q, blk_k, pad_id, contiguous):
+                      blk_q, blk_k, pad_id, contiguous, window=None):
     """Streamed forward: grid (b, h, nq, nk); K/V arrive blockwise."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -805,7 +872,7 @@ def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
         _fwd_kernel_stream(qr, kr, vr, qsr, ksr, kmmr, qmmr, bndr, offr,
                            orf, lr, accr, mr, lr2, scale=scale,
                            causal=causal, blk_q=blk_q, blk_k=blk_k,
-                           pad_id=pad_id, nk=nk)
+                           pad_id=pad_id, nk=nk, window=window)
 
     o, lse = pl.pallas_call(
         kern,
@@ -829,7 +896,8 @@ def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
 
 
 def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
-                      scale, causal, blk_q, blk_k, pad_id, contiguous):
+                      scale, causal, blk_q, blk_k, pad_id, contiguous,
+                      window=None):
     """Streamed backward: dQ over grid (b, h, nq, nk) with K/V blockwise;
     dK/dV over grid (b, h, nk, nq) with Q/dO/lse/delta blockwise. VMEM
     residency is block-bounded — in particular the lane-replicated q-id
@@ -901,7 +969,7 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
         _bwd_dq_kernel_stream(qr, kr, vr, qsr, ksr, kmmr, qmmr, bndr, offr,
                               dor, lr, dr, dqr, dq_accr, scale=scale,
                               causal=causal, blk_q=blk_q, blk_k=blk_k,
-                              pad_id=pad_id, nk=nk)
+                              pad_id=pad_id, nk=nk, window=window)
 
     dq = pl.pallas_call(
         dq_kern,
@@ -967,7 +1035,8 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
         _bwd_dkv_kernel_stream(qr, kr, vr, qsr, ksr, qmmr, kmmr, bndr, offr,
                                dor, lr, dr, dkr, dvr, dk_accr, dv_accr,
                                scale=scale, causal=causal, blk_q=blk_q,
-                               blk_k=blk_k, pad_id=pad_id, nq=nq)
+                               blk_k=blk_k, pad_id=pad_id, nq=nq,
+                               window=window)
 
     dk, dv = pl.pallas_call(
         dkv_kern,
@@ -990,17 +1059,17 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "causal", "blk_q", "blk_k", "pad_id",
-                     "contiguous", "stream"),
+                     "contiguous", "stream", "window"),
 )
 def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
                scale, causal, blk_q, blk_k, pad_id=None, contiguous=True,
-               stream=False):
+               stream=False, window=None):
     if stream:
         assert bias is None, "streamed path does not support dense bias"
         return _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg,
                                  scale=scale, causal=causal, blk_q=blk_q,
                                  blk_k=blk_k, pad_id=pad_id,
-                                 contiguous=contiguous)
+                                 contiguous=contiguous, window=window)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
@@ -1082,7 +1151,7 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
                        dr, dqr, dbr,
                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
                        pad_id=pad_id, b_bcast=b_bcast, h_bcast=h_bcast,
-                       dims=dims)
+                       dims=dims, window=window)
 
     out_specs = [qspec]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
@@ -1166,7 +1235,7 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
         _bwd_dkv_kernel(qr, kr, vr, br, qsr, ksr, qmmr, kmmr, bndr, offr,
                         dor, lr, dr, dkr, dvr,
                         scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                        pad_id=pad_id)
+                        pad_id=pad_id, window=window)
 
     dk, dv = pl.pallas_call(
         dkv_kern,
@@ -1187,31 +1256,34 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
 def _flash(q, k, v, bias, q_seg, kv_seg, scale, causal, blk_q, blk_k,
-           pad_id, contiguous, stream):
+           pad_id, contiguous, stream, window):
     o, _ = _flash_fwd(q, k, v, bias, None, q_seg, kv_seg,
                       scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                      pad_id=pad_id, contiguous=contiguous, stream=stream)
+                      pad_id=pad_id, contiguous=contiguous, stream=stream,
+                      window=window)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, bias, q_seg, kv_seg, scale, causal, blk_q, blk_k,
-                   pad_id, contiguous, stream):
+                   pad_id, contiguous, stream, window):
     o, lse = _flash_fwd(q, k, v, bias, None, q_seg, kv_seg,
                         scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                        pad_id=pad_id, contiguous=contiguous, stream=stream)
+                        pad_id=pad_id, contiguous=contiguous, stream=stream,
+                        window=window)
     return o, (q, k, v, bias, q_seg, kv_seg, o, lse)
 
 
 def _flash_vjp_bwd(scale, causal, blk_q, blk_k, pad_id, contiguous, stream,
-                   res, do):
+                   window, res, do):
     q, k, v, bias, q_seg, kv_seg, o, lse = res
     dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, None, o, lse, do,
                                    q_seg, kv_seg, scale=scale,
                                    causal=causal, blk_q=blk_q, blk_k=blk_k,
                                    pad_id=pad_id, contiguous=contiguous,
-                                   stream=stream)
+                                   stream=stream, window=window)
     if dbias is not None:
         dbias = dbias.astype(bias.dtype)
     # segment ids are integer inputs: symbolically-zero cotangents
@@ -1261,6 +1333,7 @@ def mha_reference(
     *, causal: bool = False, scale: Optional[float] = None,
     segment_ids: Optional[Tuple[jax.Array, jax.Array]] = None,
     pad_id: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Unfused XLA attention (the torch-softmax fallback path,
     fused_softmax.py:193-199 forward_torch_softmax equivalent)."""
@@ -1269,18 +1342,20 @@ def mha_reference(
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
-    masked = segment_ids is not None
+    # a cross-shape (sq != sk) window can fully mask rows too (queries
+    # past sk + window), so they need the same exact-zero treatment as
+    # segment-masked rows
+    masked = segment_ids is not None or window is not None
     if segment_ids is not None:
         q_seg, kv_seg = segment_ids
         valid = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
         if pad_id is not None:
             valid = valid & (kv_seg != pad_id)[:, None, None, :]
         s = jnp.where(valid, s, _NEG_INF)
-    if causal:
+    if causal or window is not None:
         sq, sk = s.shape[-2], s.shape[-1]
-        q_pos = jnp.arange(sq)[:, None]
-        k_pos = jnp.arange(sk)[None, :]
-        s = jnp.where(k_pos > q_pos, _NEG_INF, s)
+        s = _dense_pos_masks(s, jnp.arange(sq)[:, None],
+                             jnp.arange(sk)[None, :], causal, window)
     p = jax.nn.softmax(s, axis=-1)
     if masked:
         # match the kernel: rows with no visible key output exactly zero
@@ -1304,6 +1379,7 @@ def flash_attention(
     contiguous_segments: bool = False,
     causal: bool = False,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
     block_q: int = 1024,
     block_k: int = 1024,
     impl: str = "auto",
@@ -1335,6 +1411,15 @@ def flash_attention(
         r3 low #3).
       causal: upper-triangular masking (scaled_upper_triang_masked_softmax).
       scale: score scale; defaults to 1/sqrt(head_dim).
+      window: sliding-window (local) attention — each query attends only
+        the ``window`` most recent positions ``[p-window+1, p]`` when
+        causal (the Mistral/Longformer convention) or the symmetric band
+        ``[p-window+1, p+window-1]`` when not. Blocks wholly outside the
+        band are skipped, so score cost is O(s·window) instead of O(s²).
+        Beyond-reference capability: the reference's fmha kernels have
+        no local-attention mode; this is the standard long-context
+        pairing for the streamed kernels. Composes with ``causal``,
+        ``segment_ids``, ``bias``, and streaming.
       impl: 'auto' | 'pallas' | 'xla'.
       stream: 'auto' | 'never' | 'always' — streamed kernels move the
         K/V loop into the Pallas grid so VMEM residency is block-bounded
@@ -1346,6 +1431,12 @@ def flash_attention(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = (d ** -0.5) if scale is None else float(scale)
+    if window is not None:
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be a positive int, got {window}")
+        if window >= max(sq, sk):
+            window = None  # the band covers everything: dense attention
     use = _resolve_impl(impl)
     if use == "pallas" and not _supported(sq, sk, d):
         use = "xla"
@@ -1405,7 +1496,8 @@ def flash_attention(
         # stream-vs-bias checks (ADVICE r4: stream="always" + bias must not
         # reject an explicitly requested, working XLA path)
         return mha_reference(q, k, v, bias, causal=causal, scale=scale,
-                             segment_ids=segment_ids, pad_id=pad_id)
+                             segment_ids=segment_ids, pad_id=pad_id,
+                             window=window)
     do_stream = stream == "always" or (
         stream == "auto"
         and _resident_vmem_bytes(
@@ -1423,7 +1515,8 @@ def flash_attention(
         use = "xla"
     if use == "xla":
         return mha_reference(q, k, v, bias, causal=causal, scale=scale,
-                             segment_ids=segment_ids, pad_id=pad_id)
+                             segment_ids=segment_ids, pad_id=pad_id,
+                             window=window)
     if bias is not None:
         if bias.ndim != 4:
             raise ValueError(f"bias must be rank-4 broadcastable, got shape {bias.shape}")
@@ -1439,4 +1532,4 @@ def flash_attention(
     return _flash(q, k, v, bias, q_seg, kv_seg, scale, bool(causal),
                   blk_q, blk_k,
                   None if pad_id is None else int(pad_id),
-                  bool(contiguous_segments), do_stream)
+                  bool(contiguous_segments), do_stream, window)

@@ -61,6 +61,11 @@ def main() -> None:
                     help="chunked LM-head CE (at 32k tokens the full "
                          "(tokens, vocab) logits tensor alone is ~2 GB; "
                          "chunking keeps the head's peak HBM flat)")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window attention (GPTConfig."
+                         "attention_window): O(s*window) attention cost "
+                         "instead of O(s^2) — the local-attention pairing "
+                         "for very long contexts")
     ap.add_argument("--output", default=None,
                     help="write a JSON measurement record")
     args = ap.parse_args()
@@ -82,6 +87,7 @@ def main() -> None:
         compute_dtype=jnp.bfloat16,
         remat=True,
         lm_head_chunks=args.lm_head_chunks,
+        attention_window=args.window,
     )
     model = GPTModel(cfg)
     policy = amp.get_policy("O2")
@@ -163,6 +169,7 @@ def main() -> None:
                 "mode": mode, "batch": batch,
                 "hidden": args.hidden, "layers": args.layers,
                 "lm_head_chunks": args.lm_head_chunks,
+                "window": args.window,
                 "steps_timed": steps_timed,
                 "tokens_per_sec": round(tok_s, 1),
                 "loss_final": round(float(loss), 4),

@@ -1,0 +1,24 @@
+"""Quick 345M placement probe: one top-rung prepare + a timed step.
+
+Not part of the bench record — a session tool to detect when the
+co-tenant HBM occupation lifts (PERF_NOTES r5) so the full headline
+can be re-driven.
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax
+import bench
+
+try:
+    advance, get_loss, n_chunks, units, state, batch, rung = (
+        bench.prepare_resilient("O2", "auto", 8, 1024, 10,
+                                min_batch=8, retries=0))
+except Exception as e:  # noqa: BLE001
+    print(f"PROBE: unplaceable ({str(e)[:120]})")
+    sys.exit(1)
+t0 = time.perf_counter()
+advance()
+get_loss()
+dt = time.perf_counter() - t0
+print(f"PROBE: PLACED batch={batch} rung={rung} "
+      f"{units / dt:.0f} tok/s ({dt * 1e3 / (10 * n_chunks * 4):.1f} ms/step-ish)")
