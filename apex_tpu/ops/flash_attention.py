@@ -143,6 +143,64 @@ def _window_q_range(lo, hi, ki, blk_q, blk_k, q_off, k_off, causal, window):
     return lo, hi
 
 
+def _window_grid(blk_outer, blk_inner, n_inner, causal, window,
+                 inner_is_k=True):
+    """Window-restricted inner grid dimension for the STREAMED kernels.
+
+    The TPU grid is sequential — trips cannot be skipped, so with the
+    plain (nq, nk) grid a window saves MXU/VPU work but still pays the
+    DMA and trip overhead of every block pair: O(s²) traffic for O(s·w)
+    math (measured: 256k-token windowed training was trip-bound). This
+    helper instead shrinks the inner grid extent to the band's worst-case
+    block width and returns ``(width, base)`` where ``base(outer_idx)``
+    maps a trip to its first global inner block — used both by the
+    BlockSpec index maps (clamped, so DMA stays in bounds) and inside the
+    kernels (unclamped, so the existing [lo, hi) predicate skips the
+    clamped-over trips). Only usable when positions are statically known
+    (no ring ``offsets``: index maps see program ids only, not operands).
+
+    ``inner_is_k``: inner dim iterates k blocks for a q block (fwd/dQ);
+    False for the dK/dV pass (q blocks for a k block), where the causal
+    band extends FORWARD from the diagonal instead of backward."""
+    if window is None:
+        return None
+    if inner_is_k:
+        # valid k_pos ∈ [q_pos - window + 1, q_pos (causal) | q_pos + window - 1]
+        span = (blk_outer - 1) + (window - 1) + (0 if causal
+                                                 else (window - 1))
+        def base(oi):
+            return (oi * blk_outer - (window - 1)) // blk_inner
+    else:
+        # valid q_pos ∈ [k_pos (causal) | k_pos - window + 1, k_pos + window - 1]
+        span = (blk_outer - 1) + (window - 1) + (0 if causal
+                                                 else (window - 1))
+        def base(oi):
+            start = oi * blk_outer if causal else (
+                oi * blk_outer - (window - 1))
+            return start // blk_inner
+    width = span // blk_inner + 2  # +1 block-misalignment, +1 conservative
+    if width >= n_inner:
+        return None  # the band covers (nearly) everything: keep the full grid
+    return width, base
+
+
+def _window_grid_maps(blk_outer, blk_inner, n_inner, causal, window, offsets,
+                      inner_is_k=True):
+    """Shared unpack of :func:`_window_grid` for the three streamed
+    pallas_calls: returns ``(extent, base, index_map)`` where ``extent``
+    is the inner grid dimension, ``base`` feeds the kernel's trip→block
+    remap (None = unrestricted), and ``index_map(outer, inner)`` is the
+    CLAMPED block index for the BlockSpecs (edge trips fetch a clamped
+    block; the kernels' [lo, hi) predicate never reads it)."""
+    wg = _window_grid(blk_outer, blk_inner, n_inner, causal, window,
+                      inner_is_k) if offsets is None else None
+    if wg is None:
+        return n_inner, None, (lambda oi, ij: ij)
+    extent, base = wg
+    return extent, base, (
+        lambda oi, ij: jnp.clip(base(oi) + ij, 0, n_inner - 1))
+
+
 def _seg_mask(s, q_ids, ks_ref, j, blk_k, pad_id):
     """Mask ``s`` (blk_q, blk_k) to -inf where the q/k segment ids differ
     (or the key is padding). ``q_ids`` is the lane-replicated (blk_q, 128)
@@ -430,13 +488,18 @@ def _bwd_dkv_kernel(
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
                        bnd_ref, off_ref, o_ref, lse_ref, acc_ref, m_ref,
                        l_ref, *, scale, causal, blk_q, blk_k, pad_id, nk,
-                       window=None):
+                       window=None, k_base=None):
     qi = pl.program_id(2)
-    kj = pl.program_id(3)
+    kj_raw = pl.program_id(3)
+    # window-restricted grid (_window_grid): trip kj_raw covers global k
+    # block k_base(qi) + kj_raw; kb may fall outside [0, nk) on the band's
+    # edge trips — the [lo, hi) predicate below skips those (their DMA
+    # fetched a clamped block, never read)
+    kj = k_base(qi) + kj_raw if k_base is not None else kj_raw
     q_off = off_ref[0] if off_ref is not None else 0
     k_off = off_ref[1] if off_ref is not None else 0
 
-    @pl.when(kj == 0)
+    @pl.when(kj_raw == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
@@ -480,7 +543,7 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
-    @pl.when(kj == nk - 1)
+    @pl.when(kj_raw == pl.num_programs(3) - 1)
     def _finalize():
         l = l_ref[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -492,13 +555,14 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
                           qmm_ref, bnd_ref, off_ref, do_ref, lse_ref,
                           delta_ref, dq_ref, dq_acc_ref,
                           *, scale, causal, blk_q, blk_k, pad_id, nk,
-                          window=None):
+                          window=None, k_base=None):
     qi = pl.program_id(2)
-    kj = pl.program_id(3)
+    kj_raw = pl.program_id(3)
+    kj = k_base(qi) + kj_raw if k_base is not None else kj_raw
     q_off = off_ref[0] if off_ref is not None else 0
     k_off = off_ref[1] if off_ref is not None else 0
 
-    @pl.when(kj == 0)
+    @pl.when(kj_raw == 0)
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
@@ -539,7 +603,7 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
         dq_acc_ref[...] = dq_acc_ref[...] + scale * jax.lax.dot(
             ds, k, preferred_element_type=jnp.float32)
 
-    @pl.when(kj == nk - 1)
+    @pl.when(kj_raw == pl.num_programs(3) - 1)
     def _finalize():
         dq_ref[0, 0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
@@ -548,13 +612,14 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, qmm_ref,
                            kmm_ref, bnd_ref, off_ref, do_ref, lse_ref,
                            delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                            *, scale, causal, blk_q, blk_k, pad_id, nq,
-                           window=None):
+                           window=None, q_base=None):
     ki = pl.program_id(2)
-    qi = pl.program_id(3)
+    qi_raw = pl.program_id(3)
+    qi = q_base(ki) + qi_raw if q_base is not None else qi_raw
     q_off = off_ref[0] if off_ref is not None else 0
     k_off = off_ref[1] if off_ref is not None else 0
 
-    @pl.when(qi == 0)
+    @pl.when(qi_raw == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
@@ -602,7 +667,7 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, qmm_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(qi_raw == pl.num_programs(3) - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
@@ -809,16 +874,21 @@ def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
 
 def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
                       blk_q, blk_k, pad_id, contiguous, window=None):
-    """Streamed forward: grid (b, h, nq, nk); K/V arrive blockwise."""
+    """Streamed forward: grid (b, h, nq, nk); K/V arrive blockwise. With a
+    ``window`` and static positions (no ring offsets) the k extent shrinks
+    to the band's block width via :func:`_window_grid` — O(s·w) trips and
+    DMA instead of O(s²)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // blk_q, sk // blk_k
-    grid = (b, h, nq, nk)
+    nkw, k_base, kmap = _window_grid_maps(blk_q, blk_k, nk, causal, window,
+                                          offsets)
+    grid = (b, h, nq, nkw)
     qspec = pl.BlockSpec((1, 1, blk_q, d),
                          lambda bi, hi, qi, kj: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, 1, blk_k, d),
-                         lambda bi, hi, qi, kj: (bi, hi, kj, 0),
+                         lambda bi, hi, qi, kj: (bi, hi, kmap(qi, kj), 0),
                          memory_space=pltpu.VMEM)
     lspec = pl.BlockSpec((1, 1, blk_q, 1),
                          lambda bi, hi, qi, kj: (bi, hi, qi, 0),
@@ -836,7 +906,7 @@ def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
                          lambda bi, hi, qi, kj: (bi, qi, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _NUM_SUBLANES, blk_k),
-                         lambda bi, hi, qi, kj: (bi, 0, kj),
+                         lambda bi, hi, qi, kj: (bi, 0, kmap(qi, kj)),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 2, nk), lambda bi, hi, qi, kj: (bi, 0, 0),
                          memory_space=pltpu.SMEM),
@@ -872,7 +942,8 @@ def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
         _fwd_kernel_stream(qr, kr, vr, qsr, ksr, kmmr, qmmr, bndr, offr,
                            orf, lr, accr, mr, lr2, scale=scale,
                            causal=causal, blk_q=blk_q, blk_k=blk_k,
-                           pad_id=pad_id, nk=nk, window=window)
+                           pad_id=pad_id, nk=nk, window=window,
+                           k_base=k_base)
 
     o, lse = pl.pallas_call(
         kern,
@@ -914,13 +985,16 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
         qs_l, ks_l = _seg_layouts(q_seg, kv_seg)
         bounds_q, bounds_k, qmm, kmm = _seg_metadata(
             q_seg, kv_seg, blk_q, blk_k, pad_id)
+    # window-restricted inner grids (see _flash_fwd_stream / _window_grid)
+    nkw, k_base, kmap = _window_grid_maps(blk_q, blk_k, nk, causal, window,
+                                          offsets)
 
     # dQ pass
     qspec = pl.BlockSpec((1, 1, blk_q, d),
                          lambda bi, hi, qi, kj: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, 1, blk_k, d),
-                         lambda bi, hi, qi, kj: (bi, hi, kj, 0),
+                         lambda bi, hi, qi, kj: (bi, hi, kmap(qi, kj), 0),
                          memory_space=pltpu.VMEM)
     lblk = pl.BlockSpec((1, 1, blk_q, 1),
                         lambda bi, hi, qi, kj: (bi, hi, qi, 0),
@@ -933,7 +1007,7 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
                          lambda bi, hi, qi, kj: (bi, qi, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _NUM_SUBLANES, blk_k),
-                         lambda bi, hi, qi, kj: (bi, 0, kj),
+                         lambda bi, hi, qi, kj: (bi, 0, kmap(qi, kj)),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 2, nk), lambda bi, hi, qi, kj: (bi, 0, 0),
                          memory_space=pltpu.SMEM),
@@ -969,11 +1043,12 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
         _bwd_dq_kernel_stream(qr, kr, vr, qsr, ksr, kmmr, qmmr, bndr, offr,
                               dor, lr, dr, dqr, dq_accr, scale=scale,
                               causal=causal, blk_q=blk_q, blk_k=blk_k,
-                              pad_id=pad_id, nk=nk, window=window)
+                              pad_id=pad_id, nk=nk, window=window,
+                              k_base=k_base)
 
     dq = pl.pallas_call(
         dq_kern,
-        grid=(b, h, nq, nk),
+        grid=(b, h, nq, nkw),
         in_specs=in_specs,
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
@@ -982,21 +1057,23 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
     )(*args)[0]
 
     # dK/dV pass
+    nqw, q_base, qmap = _window_grid_maps(blk_k, blk_q, nq, causal, window,
+                                          offsets, inner_is_k=False)
     qspec2 = pl.BlockSpec((1, 1, blk_q, d),
-                          lambda bi, hi, ki, qi: (bi, hi, qi, 0),
+                          lambda bi, hi, ki, qi: (bi, hi, qmap(ki, qi), 0),
                           memory_space=pltpu.VMEM)
     kspec2 = pl.BlockSpec((1, 1, blk_k, d),
                           lambda bi, hi, ki, qi: (bi, hi, ki, 0),
                           memory_space=pltpu.VMEM)
     lblk2 = pl.BlockSpec((1, 1, blk_q, 1),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0),
+                         lambda bi, hi, ki, qi: (bi, hi, qmap(ki, qi), 0),
                          memory_space=pltpu.VMEM)
     in_specs2 = [qspec2, kspec2, kspec2]
     args2 = [q, k, v]
     if has_seg:
         in_specs2 += [
             pl.BlockSpec((1, blk_q, _NUM_LANES),
-                         lambda bi, hi, ki, qi: (bi, qi, 0),
+                         lambda bi, hi, ki, qi: (bi, qmap(ki, qi), 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, _NUM_SUBLANES, blk_k),
                          lambda bi, hi, ki, qi: (bi, 0, ki),
@@ -1036,11 +1113,11 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
                                dor, lr, dr, dkr, dvr, dk_accr, dv_accr,
                                scale=scale, causal=causal, blk_q=blk_q,
                                blk_k=blk_k, pad_id=pad_id, nq=nq,
-                               window=window)
+                               window=window, q_base=q_base)
 
     dk, dv = pl.pallas_call(
         dkv_kern,
-        grid=(b, h, nk, nq),
+        grid=(b, h, nk, nqw),
         in_specs=in_specs2,
         out_specs=[kspec2, kspec2],
         out_shape=[

@@ -538,3 +538,55 @@ def test_window_cross_shape_fully_masked_rows_zero_both_impls():
     out_x = flash_attention(q, k, v, causal=True, window=16, impl="xla")
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_window_restricted_streamed_grid(causal):
+    """The window-RESTRICTED streamed grid (inner extent < nk, trips
+    remapped via _window_grid) — both causal and bidirectional branches
+    must be live (sq=512, blk=64, window=16 -> width 3 of nk=8) and match
+    the resident layout, values and grads."""
+    from apex_tpu.ops.flash_attention import _window_grid
+
+    assert _window_grid(64, 64, 8, causal, 16) is not None
+    q, k, v = _qkv(jax.random.PRNGKey(28), sq=512, sk=512)
+    kw = dict(causal=causal, window=16, impl="pallas", block_q=64,
+              block_k=64)
+    out_s = flash_attention(q, k, v, stream="always", **kw)
+    out_r = flash_attention(q, k, v, stream="never", **kw)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r),
+                               rtol=1e-6, atol=1e-6)
+    gs = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, stream="always", **kw) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, stream="never", **kw) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("contiguous", [False, True])
+def test_window_restricted_grid_with_segments(contiguous):
+    """Restricted windowed grid + segment ids: the remapped kmap/qmap
+    BlockSpecs must fetch the RIGHT id blocks and metadata (sq=512,
+    window=32, blk 64/128 -> restricted), kernel vs XLA, fwd + grads."""
+    from apex_tpu.ops.flash_attention import _window_grid
+
+    assert _window_grid(64, 128, 4, True, 32) is not None
+    q, k, v = _qkv(jax.random.PRNGKey(29), sq=512, sk=512)
+    seg = jnp.asarray(
+        np.repeat([1, 2, 3, 9], [128, 192, 128, 64])[None].repeat(B, 0))
+    kw = dict(segment_ids=(seg, seg), pad_id=9, causal=True, window=32)
+    out_s = flash_attention(q, k, v, stream="always", impl="pallas",
+                            block_q=64, block_k=128,
+                            contiguous_segments=contiguous, **kw)
+    out_x = flash_attention(q, k, v, impl="xla", **kw)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_x),
+                               rtol=2e-5, atol=2e-5)
+    gs = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, stream="always", impl="pallas", block_q=64, block_k=128,
+        contiguous_segments=contiguous, **kw) ** 2))(q)
+    gx = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, impl="xla", **kw) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gx),
+                               rtol=1e-4, atol=1e-4)
