@@ -164,24 +164,34 @@ def _window_grid(blk_outer, blk_inner, n_inner, causal, window,
     band extends FORWARD from the diagonal instead of backward."""
     if window is None:
         return None
-    if inner_is_k:
-        # valid k_pos ∈ [q_pos - window + 1, q_pos (causal) | q_pos + window - 1]
-        span = (blk_outer - 1) + (window - 1) + (0 if causal
-                                                 else (window - 1))
-        def base(oi):
-            return (oi * blk_outer - (window - 1)) // blk_inner
-    else:
-        # valid q_pos ∈ [k_pos (causal) | k_pos - window + 1, k_pos + window - 1]
-        span = (blk_outer - 1) + (window - 1) + (0 if causal
-                                                 else (window - 1))
-        def base(oi):
-            start = oi * blk_outer if causal else (
-                oi * blk_outer - (window - 1))
-            return start // blk_inner
+    # the band spans the outer block plus (window-1) on the trailing side,
+    # plus another (window-1) leading when bidirectional; under causal the
+    # trailing side is behind the diagonal for k-inner (fwd/dQ) but AHEAD
+    # of it for q-inner (dK/dV), which only moves the band's start:
+    #   k-inner: k_pos ∈ [q_pos - window + 1, q_pos | q_pos + window - 1]
+    #   q-inner: q_pos ∈ [k_pos | k_pos - window + 1, k_pos + window - 1]
+    span = (blk_outer - 1) + (window - 1) + (0 if causal else (window - 1))
+    back = 0 if (causal and not inner_is_k) else window - 1
+
+    def base(oi):
+        return (oi * blk_outer - back) // blk_inner
+
     width = span // blk_inner + 2  # +1 block-misalignment, +1 conservative
     if width >= n_inner:
         return None  # the band covers (nearly) everything: keep the full grid
     return width, base
+
+
+def _lse_group(nq):
+    """Row-group size for the dense (b, h, nq, blk_q) lse/delta tables.
+
+    Groups of 8 rows keep the in-VMEM window at 8·blk_q·4 bytes no matter
+    the sequence length (the whole-table window is sq·4 bytes, which blew
+    the 16 MB scoped-VMEM limit at 1M tokens); 8 divides every large
+    power-of-two nq, and the whole-table fallback only triggers for small
+    odd nq where the table is tiny anyway. The second-minor block dim must
+    be a multiple of 8 or the full dim — both branches satisfy that."""
+    return 8 if nq % 8 == 0 and nq >= 8 else nq
 
 
 def _window_grid_maps(blk_outer, blk_inner, n_inner, causal, window, offsets,
@@ -488,7 +498,7 @@ def _bwd_dkv_kernel(
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
                        bnd_ref, off_ref, o_ref, lse_ref, acc_ref, m_ref,
                        l_ref, *, scale, causal, blk_q, blk_k, pad_id, nk,
-                       window=None, k_base=None):
+                       window=None, k_base=None, lse_group=1):
     qi = pl.program_id(2)
     kj_raw = pl.program_id(3)
     # window-restricted grid (_window_grid): trip kj_raw covers global k
@@ -548,14 +558,18 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref, qmm_ref,
         l = l_ref[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[...] + jnp.log(l_safe)
+        # lse rides in the DENSE (b, h, nq, blk_q) layout (see
+        # _flash_bwd_stream): transpose this block's (blk_q, 1) column
+        # into row qi of the per-head table (windowed in lse_group rows)
+        lse_ref[0, 0, pl.ds(qi % lse_group, 1), :] = jnp.transpose(
+            m_ref[...] + jnp.log(l_safe), (1, 0))
 
 
 def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
                           qmm_ref, bnd_ref, off_ref, do_ref, lse_ref,
                           delta_ref, dq_ref, dq_acc_ref,
                           *, scale, causal, blk_q, blk_k, pad_id, nk,
-                          window=None, k_base=None):
+                          window=None, k_base=None, lse_group=1):
     qi = pl.program_id(2)
     kj_raw = pl.program_id(3)
     kj = k_base(qi) + kj_raw if k_base is not None else kj_raw
@@ -581,8 +595,12 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, kmm_ref,
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        # dense-table layout: row qi of (nq, blk_q), reoriented to a
+        # (blk_q, 1) column (see _flash_fwd_stream's lse note)
+        lse = jnp.transpose(lse_ref[0, 0, pl.ds(qi % lse_group, 1), :],
+                            (1, 0))
+        delta = jnp.transpose(delta_ref[0, 0, pl.ds(qi % lse_group, 1), :],
+                              (1, 0))
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
@@ -612,7 +630,7 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, qmm_ref,
                            kmm_ref, bnd_ref, off_ref, do_ref, lse_ref,
                            delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                            *, scale, causal, blk_q, blk_k, pad_id, nq,
-                           window=None, q_base=None):
+                           window=None, q_base=None, lse_group=1):
     ki = pl.program_id(2)
     qi_raw = pl.program_id(3)
     qi = q_base(ki) + qi_raw if q_base is not None else qi_raw
@@ -640,8 +658,13 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, qs_ref, ks_ref, qmm_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         q = q_ref[0, 0].astype(jnp.float32)  # (blk_q, d)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        # dense-table layout; qi is the (possibly remapped) global q
+        # block — in range whenever this trip computes (the predicate),
+        # so the fetched group is the one containing it
+        lse = jnp.transpose(lse_ref[0, 0, pl.ds(qi % lse_group, 1), :],
+                            (1, 0))
+        delta = jnp.transpose(delta_ref[0, 0, pl.ds(qi % lse_group, 1), :],
+                              (1, 0))
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (blk_q, blk_k)
@@ -890,9 +913,18 @@ def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
     kspec = pl.BlockSpec((1, 1, blk_k, d),
                          lambda bi, hi, qi, kj: (bi, hi, kmap(qi, kj), 0),
                          memory_space=pltpu.VMEM)
-    lspec = pl.BlockSpec((1, 1, blk_q, 1),
-                         lambda bi, hi, qi, kj: (bi, hi, qi, 0),
-                         memory_space=pltpu.VMEM)
+    # lse travels as a DENSE (b, h, nq, blk_q) table — a (b, h, sq, 1)
+    # custom-call operand gets the T(8, 128) layout, which lane-pads the
+    # size-1 minor dim 128x: at 512k tokens that is a 2 GB HBM buffer for
+    # 16 MB of logsumexp (measured; the official TPU flash/splash kernels
+    # pay the same via their (..., 128) replication). The table is
+    # windowed in _lse_group-row groups (constant VMEM at any sequence
+    # length) and each block reads or writes its row with a cheap
+    # (1, blk) <-> (blk, 1) transpose.
+    lse_g = _lse_group(nq)
+    lse_spec = pl.BlockSpec((1, 1, lse_g, blk_q),
+                            lambda bi, hi, qi, kj: (bi, hi, qi // lse_g, 0),
+                            memory_space=pltpu.VMEM)
     in_specs = [qspec, kspec, kspec]
     args = [q, k, v]
     has_seg = q_seg is not None
@@ -943,16 +975,16 @@ def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
                            orf, lr, accr, mr, lr2, scale=scale,
                            causal=causal, blk_q=blk_q, blk_k=blk_k,
                            pad_id=pad_id, nk=nk, window=window,
-                           k_base=k_base)
+                           k_base=k_base, lse_group=lse_g)
 
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[qspec, lspec],
+        out_specs=[qspec, lse_spec],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, nq, blk_q), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, d), jnp.float32),
@@ -961,6 +993,9 @@ def _flash_fwd_stream(q, k, v, offsets, q_seg, kv_seg, *, scale, causal,
         ],
         interpret=_interpret(),
     )(*args)
+    # external interface stays (b, h, sq, 1) — a plain XLA reshape, dense
+    # either way outside the custom call
+    lse = lse.reshape(b, h, sq, 1)
     o = checkpoint_name(o, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
     return o, lse
@@ -976,8 +1011,12 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // blk_q, sk // blk_k
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
-                    keepdims=True)
+    # lse/delta in the dense (b, h, nq, blk_q) table layout (see
+    # _flash_fwd_stream) — the (b, h, sq, 1) shape would be lane-padded
+    # 128x at the custom-call boundary
+    lse = lse.reshape(b, h, nq, blk_q)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1).reshape(b, h, nq, blk_q)
     has_seg = q_seg is not None
     has_bnd = has_seg and contiguous
     has_off = offsets is not None
@@ -996,8 +1035,9 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
     kspec = pl.BlockSpec((1, 1, blk_k, d),
                          lambda bi, hi, qi, kj: (bi, hi, kmap(qi, kj), 0),
                          memory_space=pltpu.VMEM)
-    lblk = pl.BlockSpec((1, 1, blk_q, 1),
-                        lambda bi, hi, qi, kj: (bi, hi, qi, 0),
+    lse_g = _lse_group(nq)
+    lblk = pl.BlockSpec((1, 1, lse_g, blk_q),
+                        lambda bi, hi, qi, kj: (bi, hi, qi // lse_g, 0),
                         memory_space=pltpu.VMEM)
     in_specs = [qspec, kspec, kspec]
     args = [q, k, v]
@@ -1044,7 +1084,7 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
                               dor, lr, dr, dqr, dq_accr, scale=scale,
                               causal=causal, blk_q=blk_q, blk_k=blk_k,
                               pad_id=pad_id, nk=nk, window=window,
-                              k_base=k_base)
+                              k_base=k_base, lse_group=lse_g)
 
     dq = pl.pallas_call(
         dq_kern,
@@ -1065,8 +1105,9 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
     kspec2 = pl.BlockSpec((1, 1, blk_k, d),
                           lambda bi, hi, ki, qi: (bi, hi, ki, 0),
                           memory_space=pltpu.VMEM)
-    lblk2 = pl.BlockSpec((1, 1, blk_q, 1),
-                         lambda bi, hi, ki, qi: (bi, hi, qmap(ki, qi), 0),
+    lblk2 = pl.BlockSpec((1, 1, lse_g, blk_q),
+                         lambda bi, hi, ki, qi: (bi, hi,
+                                                 qmap(ki, qi) // lse_g, 0),
                          memory_space=pltpu.VMEM)
     in_specs2 = [qspec2, kspec2, kspec2]
     args2 = [q, k, v]
@@ -1113,7 +1154,8 @@ def _flash_bwd_stream(q, k, v, offsets, o, lse, do, q_seg, kv_seg, *,
                                dor, lr, dr, dkr, dvr, dk_accr, dv_accr,
                                scale=scale, causal=causal, blk_q=blk_q,
                                blk_k=blk_k, pad_id=pad_id, nq=nq,
-                               window=window, q_base=q_base)
+                               window=window, q_base=q_base,
+                               lse_group=lse_g)
 
     dk, dv = pl.pallas_call(
         dkv_kern,
