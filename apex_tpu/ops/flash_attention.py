@@ -250,7 +250,7 @@ def _seg_mask_if_needed(s, qs_ref, ks_ref, kmm_ref, j_meta, j_slice, blk_k,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref,
                 off_ref, o_ref, lse_ref, *, scale, causal, blk_q, blk_k,
-                pad_id, window=None):
+                pad_id, window=None, lse_group=1):
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
     sk = k_ref.shape[2]
     d = q.shape[-1]
@@ -313,7 +313,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref,
     # Fully-masked rows (padding segments, all -inf bias rows) have l == 0.
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)
+    # lse rides in the dense (b, h, nq, blk_q) table layout (grouped rows;
+    # see _flash_fwd_stream's note — the (b, h, sq, 1) shape lane-pads
+    # 128x at the custom-call boundary)
+    lse_ref[0, 0, pl.ds(qi % lse_group, 1), :] = jnp.transpose(
+        m + jnp.log(l_safe), (1, 0))
 
 
 # ---------------------------------------------------------------------------
@@ -325,16 +329,18 @@ def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, b_ref, qs_ref, ks_ref, kmm_ref, bnd_ref, off_ref,
     do_ref, lse_ref, delta_ref, dq_ref, db_ref,
     *, scale, causal, blk_q, blk_k, pad_id, b_bcast, h_bcast, dims,
-    window=None,
+    window=None, lse_group=1,
 ):
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
     sk = k_ref.shape[2]
     # dims maps logical (b, h, q) grid coordinates to program_id positions —
     # _flash_bwd orders the grid so dbias revisits are *consecutive*.
     qi = pl.program_id(dims["q"])
+    # dense (b, h, nq, blk_q) table layout (see _flash_fwd_stream)
+    lse = jnp.transpose(lse_ref[0, 0, pl.ds(qi % lse_group, 1), :], (1, 0))
+    delta = jnp.transpose(delta_ref[0, 0, pl.ds(qi % lse_group, 1), :],
+                          (1, 0))
     nk = sk // blk_k
     q_off = off_ref[0] if off_ref is not None else 0
     k_off = off_ref[1] if off_ref is not None else 0
@@ -432,8 +438,10 @@ def _bwd_dkv_kernel(
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * blk_q, blk_q), :]
-        delta = delta_ref[0, 0, pl.ds(i * blk_q, blk_q), :]
+        # dense (b, h, nq, blk_q) tables, full-resident here (sq·4 bytes —
+        # 64x less VMEM than the lane-padded (sq, 1) windows they replace)
+        lse = jnp.transpose(lse_ref[0, 0, pl.ds(i, 1), :], (1, 0))
+        delta = jnp.transpose(delta_ref[0, 0, pl.ds(i, 1), :], (1, 0))
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (blk_q, blk_k)
@@ -826,13 +834,16 @@ def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
                                  scale=scale, causal=causal, blk_q=blk_q,
                                  blk_k=blk_k, pad_id=pad_id,
                                  contiguous=contiguous, window=window)
-    grid = (b, h, sq // blk_q)
+    nq = sq // blk_q
+    grid = (b, h, nq)
+    lse_g = _lse_group(nq)
     qspec = pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0),
                          memory_space=pltpu.VMEM)
     ospec = qspec
-    lspec = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0),
+    lspec = pl.BlockSpec((1, 1, lse_g, blk_q),
+                         lambda bi, hi, qi: (bi, hi, qi // lse_g, 0),
                          memory_space=pltpu.VMEM)
     in_specs = [qspec, kspec, kspec]
     args = [q, k, v]
@@ -873,7 +884,7 @@ def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
         orf, lr = refs[i], refs[i + 1]
         _fwd_kernel(qr, kr, vr, br, qsr, ksr, kmmr, bndr, offr, orf, lr,
                     scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                    pad_id=pad_id, window=window)
+                    pad_id=pad_id, window=window, lse_group=lse_g)
 
     o, lse = pl.pallas_call(
         kern,
@@ -882,10 +893,11 @@ def _flash_fwd(q, k, v, bias, offsets, q_seg=None, kv_seg=None, *,
         out_specs=[ospec, lspec],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, nq, blk_q), jnp.float32),
         ],
         interpret=_interpret(),
     )(*args)
+    lse = lse.reshape(b, h, sq, 1)  # dense either way outside the call
     # Named for selective activation checkpointing: a remat policy saving
     # these (e.g. GPTConfig.remat_policy="save_attn") keeps the kernel's
     # output + logsumexp so backward never re-runs the forward kernel —
@@ -1191,8 +1203,12 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
                                  contiguous=contiguous, window=window)
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
-                    keepdims=True)  # (b, h, sq, 1)
+    nq = sq // blk_q
+    lse_g = _lse_group(nq)
+    # dense (b, h, nq, blk_q) lse/delta tables (see _flash_fwd_stream)
+    lse = lse.reshape(b, h, nq, blk_q)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1).reshape(b, h, nq, blk_q)
     has_seg = q_seg is not None
     has_bnd = has_seg and contiguous
     if has_seg:
@@ -1222,7 +1238,8 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
                          memory_space=pltpu.VMEM)
     kfull = pl.BlockSpec((1, 1, sk, d), reorder(lambda bi, hi, qi: (bi, hi, 0, 0)),
                          memory_space=pltpu.VMEM)
-    lblk = pl.BlockSpec((1, 1, blk_q, 1), reorder(lambda bi, hi, qi: (bi, hi, qi, 0)),
+    lblk = pl.BlockSpec((1, 1, lse_g, blk_q),
+                        reorder(lambda bi, hi, qi: (bi, hi, qi // lse_g, 0)),
                         memory_space=pltpu.VMEM)
 
     in_specs = [qspec, kfull, kfull]
@@ -1270,7 +1287,7 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
                        dr, dqr, dbr,
                        scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k,
                        pad_id=pad_id, b_bcast=b_bcast, h_bcast=h_bcast,
-                       dims=dims, window=window)
+                       dims=dims, window=window, lse_group=lse_g)
 
     out_specs = [qspec]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
@@ -1296,7 +1313,7 @@ def _flash_bwd(q, k, v, bias, offsets, o, lse, do, q_seg=None, kv_seg=None, *,
                          memory_space=pltpu.VMEM)
     kblk = pl.BlockSpec((1, 1, blk_k, d), lambda bi, hi, ki: (bi, hi, ki, 0),
                         memory_space=pltpu.VMEM)
-    lfull = pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0),
+    lfull = pl.BlockSpec((1, 1, nq, blk_q), lambda bi, hi, ki: (bi, hi, 0, 0),
                          memory_space=pltpu.VMEM)
     in_specs2 = [qfull, kblk, kblk]
     args2 = [q, k, v]
@@ -1430,20 +1447,38 @@ def _resident_vmem_bytes(sq, sk, d, blk_q, blk_k, itemsize, has_bias,
     with TOTAL tokens, not max_seqlen, on the packed path).
 
     VMEM tiles pad the MINOR dim to the 128-lane vreg width: a head_dim
-    of 32 occupies 128 lanes, and the (sq, 1) lse/delta windows of the
-    dK/dV pass occupy sq x 128 — observed live: a d=32, s=8192 resident
+    of 32 occupies 128 lanes — observed live: a d=32, s=8192 resident
     dK/dV pass allocates 17.3 MB where the unpadded arithmetic says
     1.6 MB. The estimate must count PADDED bytes or 'auto' keeps
-    resident layouts that cannot compile."""
+    resident layouts that cannot compile. (lse/delta now travel as dense
+    (nq, blk_q) tables — sq·4 bytes each, no lane padding — so they no
+    longer dominate; the q/do/K/V operand padding does.)"""
     d_eff = -(-d // _NUM_LANES) * _NUM_LANES
     seg_fwd = (blk_q * _NUM_LANES + _NUM_SUBLANES * sk) * 4 if has_seg else 0
     fwd = (2 * sk * d_eff * itemsize
            + (blk_q * sk * 4 if has_bias else 0) + seg_fwd)
     seg_dkv = (sq * _NUM_LANES + _NUM_SUBLANES * sk) * 4 if has_seg else 0
     dkv = (3 * sq * d_eff * itemsize  # q, do (+ dq-pass K/V ≈ fwd term)
-           + 2 * sq * _NUM_LANES * 4  # lse + delta, lane-padded
+           + 2 * sq * 4  # lse + delta dense tables
            + (sq * blk_k * 4 if has_bias else 0) + seg_dkv)
     return max(fwd, dkv)
+
+
+def _auto_stream(sq, sk, d, blk_q, blk_k, itemsize, has_bias, has_seg):
+    """The stream='auto' decision, shared with ``ring_attention``:
+    ``(vmem_wall, crossover)``.
+
+    ``vmem_wall``: the resident layout's estimated residency exceeds the
+    VMEM budget — it cannot compile, streaming is mandatory.
+    ``crossover``: a measured THROUGHPUT boundary, not a memory wall: the
+    resident dK/dV pass re-streams whole-sq q/do per k block (O(nk·sq·d)
+    DMA) and falls behind the streamed layout past ~2k — on-chip fwd+bwd
+    d=64: s=2048 resident 12.2 vs streamed 13.4 ms, s=4096 resident 27.4
+    vs streamed 17.7 ms. (The dense lse tables made 4096-resident
+    COMPILE, so the wall check alone would pick the slower layout.)"""
+    wall = _resident_vmem_bytes(sq, sk, d, blk_q, blk_k, itemsize,
+                                has_bias, has_seg) > _RESIDENT_VMEM_BUDGET
+    return wall, max(sq, sk) >= 4096
 
 
 def mha_reference(
@@ -1617,21 +1652,24 @@ def flash_attention(
         return mha_reference(q, k, v, bias, causal=causal, scale=scale,
                              segment_ids=segment_ids, pad_id=pad_id,
                              window=window)
+    vmem_wall, crossover = _auto_stream(
+        sq, sk, d, blk_q, blk_k, q.dtype.itemsize, bias is not None,
+        segment_ids is not None)
     do_stream = stream == "always" or (
-        stream == "auto"
-        and _resident_vmem_bytes(
-            sq, sk, d, blk_q, blk_k, q.dtype.itemsize, bias is not None,
-            segment_ids is not None) > _RESIDENT_VMEM_BUDGET)
+        stream == "auto" and (vmem_wall or crossover))
     if do_stream and bias is not None:
         if stream == "always":
             raise ValueError("stream='always' does not support dense bias; "
                              "use segment_ids/causal for long sequences")
-        # auto: the streamed path lacks the dbias pass, and the resident
-        # layout was just estimated NOT to fit VMEM — proceeding into it
-        # would die with an opaque Mosaic allocation failure, so take the
-        # XLA path (functional, HBM-bound) instead
+        # auto: the streamed path lacks the dbias pass. If the RESIDENT
+        # layout cannot fit VMEM, proceeding into it would die with an
+        # opaque Mosaic allocation failure — take the XLA path
+        # (functional, HBM-bound) instead. A throughput-crossover-only
+        # trigger keeps the resident kernel: it compiles and beats dense
+        # XLA attention even past the crossover.
         do_stream = False
-        use = "xla"
+        if vmem_wall:
+            use = "xla"
     if use == "xla":
         return mha_reference(q, k, v, bias, causal=causal, scale=scale,
                              segment_ids=segment_ids, pad_id=pad_id,

@@ -41,12 +41,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.ops.flash_attention import (
-    _RESIDENT_VMEM_BUDGET,
+    _auto_stream,
     _dense_pos_masks,
     _flash_bwd,
     _flash_fwd,
     _pick_block,
-    _resident_vmem_bytes,
     _supported,
 )
 from apex_tpu.ops.layer_norm import _resolve_impl
@@ -280,10 +279,10 @@ def ring_attention(
             or not seg_blocks_ok):
         return _ring_xla(q, k, v, axis, causal, scale, q_seg, kv_seg, pad_id,
                          window)
-    # per-shard VMEM decision, same heuristic as flash_attention's 'auto'
-    stream = _resident_vmem_bytes(
-        sq, sk, d, blk_q, blk_k, q.dtype.itemsize, False,
-        q_seg is not None) > _RESIDENT_VMEM_BUDGET
+    # per-shard decision: flash_attention's shared 'auto' heuristic
+    # (VMEM wall, or the measured ≥4k resident-vs-streamed crossover)
+    stream = any(_auto_stream(sq, sk, d, blk_q, blk_k, q.dtype.itemsize,
+                              False, q_seg is not None))
     return _ring(q, k, v, q_seg, kv_seg, axis, bool(causal), scale, blk_q,
                  blk_k, pad_id, stream, window)
 

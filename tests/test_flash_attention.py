@@ -590,3 +590,42 @@ def test_window_restricted_grid_with_segments(contiguous):
         q, k, v, impl="xla", **kw) ** 2))(q)
     np.testing.assert_allclose(np.asarray(gs), np.asarray(gx),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_stream_auto_crossover_at_4k():
+    """'auto' streams at s >= 4096 even though the resident layout now
+    COMPILES there (dense lse tables removed its VMEM wall): measured
+    on-chip, resident dK/dV falls behind streamed past ~2k (27.4 vs
+    17.7 ms at 4096 d=64) because it re-streams whole-sq q/do per k
+    block. Asserted on the shared decision helper (jit-cache-proof)."""
+    from apex_tpu.ops.flash_attention import _auto_stream
+
+    wall, crossover = _auto_stream(4096, 4096, 64, 1024, 1024, 2,
+                                   False, False)
+    assert crossover and not wall  # streams on throughput, not memory
+    wall, crossover = _auto_stream(2048, 2048, 64, 1024, 1024, 2,
+                                   False, False)
+    assert not crossover and not wall  # model shapes stay resident
+
+
+def test_bias_past_crossover_keeps_resident_kernel():
+    """Dense bias + the >= 4k crossover: the streamed path has no dbias
+    pass, but the resident kernel COMPILES there (no VMEM wall) and
+    beats dense XLA attention — auto must keep it rather than fall back
+    to mha_reference (r5 review finding)."""
+    from apex_tpu.ops.flash_attention import _auto_stream
+
+    # blk_q=128 keeps the resident bias window small: crossover fires
+    # but the wall does NOT — the branch under test
+    wall, crossover = _auto_stream(4096, 4096, D, 128, 128, 2, True, False)
+    assert crossover and not wall
+    q, k, v = _qkv(jax.random.PRNGKey(31), sq=4096, sk=4096,
+                   dtype=jnp.bfloat16)
+    bias = jnp.zeros((B, 1, 4096, 4096))
+    bias = bias.at[1, :, :, -64:].set(-10000.0)
+    out = flash_attention(q, k, v, bias, causal=True, impl="pallas",
+                          block_q=128, block_k=128)
+    ref = mha_reference(q, k, v, bias, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
