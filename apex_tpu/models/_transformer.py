@@ -52,6 +52,44 @@ class SegmentMask:
     pad_id: Optional[int] = None
 
 
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (split-half / NeoX convention) on
+    ``(b, nh, s, d)`` with explicit GLOBAL ``positions`` of shape ``(s,)``.
+
+    Scores become functions of relative distance only —
+    ``rope(q, p)·rope(k, p') == rope(q, p+s)·rope(k, p'+s)`` (unit-tested)
+    — so the per-shard global positions make it exact under ring/Ulysses
+    context parallelism, and no position table exists at all: at 1M
+    tokens the learned table alone is ~3.75 GB of params+optimizer state.
+    Beyond-reference capability (the reference's GPT is learned-position
+    only, standalone_gpt.py embeddings)."""
+    import numpy as np
+
+    d = x.shape[-1]
+    half = d // 2
+    # Angle precision at long context: pos · inv_freq in f32 carries a
+    # relative 1e-7 error, which at pos = 1e6 is up to ~0.1 rad for the
+    # highest frequency. Split the (exact, integer) position as
+    # a·K + r and pre-reduce K·inv_freq modulo 2π in float64 at trace
+    # time, so every f32 product stays small (≲ 3e3 rad → ≤ 3e-4 rad
+    # error at 1M tokens).
+    K = 2048
+    inv64 = theta ** (-np.arange(half, dtype=np.float64) * 2.0 / d)
+    kmod = jnp.asarray(np.mod(K * inv64, 2 * np.pi), jnp.float32)
+    inv_freq = jnp.asarray(inv64, jnp.float32)
+    a = (positions // K).astype(jnp.float32)[:, None]
+    r = (positions % K).astype(jnp.float32)[:, None]
+    ang = a * kmod + r * inv_freq  # (s, half)
+    cos = jnp.cos(ang)
+    sin = jnp.sin(ang)
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def _remat_policy(name: Optional[str]):
     """Selective activation-checkpoint policies (reference: the sharded
     activation buffer knob of tensor_parallel/random.py:45-76 — the
@@ -198,6 +236,11 @@ class TransformerBase:
             n_local = qkv.shape[-1] // (3 * c.head_dim)
             qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
+            if getattr(c, "position_embedding", "learned") == "rope":
+                pos = self._token_positions(s)
+                theta = getattr(c, "rope_theta", 10000.0)
+                q = apply_rope(q, pos, theta)
+                k = apply_rope(k, pos, theta)
             attn = self._attend(q, k, v, bias)
             attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
             return self.proj.apply(p["proj"], attn)
@@ -211,6 +254,13 @@ class TransformerBase:
             start = lax.axis_index(ctx) * s_local
             return lax.dynamic_slice_in_dim(pos_table, start, s_local, axis=0)
         return pos_table[:s_local]
+
+    def _token_positions(self, s_local: int) -> jax.Array:
+        """GLOBAL positions of this shard's tokens (for rotary embedding):
+        the same shard-offset contract as :meth:`_positions`."""
+        ctx = getattr(self.cfg, "context_axis", None)
+        start = lax.axis_index(ctx) * s_local if ctx is not None else 0
+        return start + jnp.arange(s_local, dtype=jnp.int32)
 
     def _attend(self, q, k, v, bias):
         """Core attention on (b, nh, s, d). With ``cfg.context_axis`` set the

@@ -71,6 +71,13 @@ class GPTConfig:
     # window is defined in global positions and rides the ring offsets).
     # None = full attention. Beyond-reference capability.
     attention_window: Optional[int] = None
+    # Position encoding: "learned" (reference parity — a trained
+    # (max_seq_len, hidden) table) | "rope" (rotary on q/k, NO position
+    # params at all: at 1M tokens the learned table is ~3.75 GB of
+    # params + Adam state; relative-distance property makes it exact
+    # under context parallelism with shard-offset positions) | "none".
+    position_embedding: str = "learned"
+    rope_theta: float = 10000.0
     # Drive the (still stacked) layer params with an unrolled Python loop
     # of static per-layer slices instead of lax.scan. Measured on-chip at
     # 345M: the scan's backward accumulates layer grads through
@@ -128,6 +135,13 @@ class GPTModel(TransformerBase):
     def __init__(self, config):
         super().__init__(config)
         c = config
+        if c.position_embedding not in ("learned", "rope", "none"):
+            raise ValueError(
+                f"position_embedding must be learned|rope|none, got "
+                f"{c.position_embedding!r}")
+        if c.position_embedding == "rope" and c.head_dim % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {c.head_dim}")
         if c.moe_num_experts is not None:
             from apex_tpu.transformer.moe import MoEMLP
 
@@ -174,23 +188,25 @@ class GPTModel(TransformerBase):
     def init(self, key: jax.Array) -> Params:
         c = self.cfg
         keys = jax.random.split(key, 4)
-        pos = tp.scaled_normal(c.init_method_std)(
-            keys[1], (c.max_seq_len, c.hidden_size), c.params_dtype
-        )
-        return {
+        tree = {
             "embedding": self.embedding.init(keys[0]),
-            "position": pos,
             "layers": self.init_layer_stack(keys[2]),
             "ln_f": self._ln_init(),
         }
+        if c.position_embedding == "learned":
+            tree["position"] = tp.scaled_normal(c.init_method_std)(
+                keys[1], (c.max_seq_len, c.hidden_size), c.params_dtype)
+        return tree
 
     def specs(self) -> Params:
-        return {
+        tree = {
             "embedding": self.embedding.specs(),
-            "position": P(),
             "layers": self.layer_stack_specs(),
             "ln_f": {"scale": P(), "bias": P()},
         }
+        if self.cfg.position_embedding == "learned":
+            tree["position"] = P()
+        return tree
 
     # -- stages -------------------------------------------------------------
 
@@ -198,8 +214,11 @@ class GPTModel(TransformerBase):
         c = self.cfg
         with jax.named_scope("embed"):
             h = self.embedding.apply(params["embedding"], tokens)
-            pos = self._positions(params["position"], tokens.shape[-1])
-            return (h + pos).astype(c.compute_dtype)
+            if c.position_embedding == "learned":
+                h = h + self._positions(params["position"], tokens.shape[-1])
+            # "rope": positions enter at the q/k rotation in _attention;
+            # "none": no positional signal at the embedding
+            return h.astype(c.compute_dtype)
 
     def _layer(self, p: Params, h: jax.Array, key, bias=None) -> jax.Array:
         """Pre-LN block: residual + sublayer(LN(h))."""

@@ -66,6 +66,11 @@ def main() -> None:
                          "attention_window): O(s*window) attention cost "
                          "instead of O(s^2) — the local-attention pairing "
                          "for very long contexts")
+    ap.add_argument("--pos", choices=["learned", "rope", "none"],
+                    default="learned",
+                    help="position encoding; rope has NO position table "
+                         "(a learned table at 1M tokens is ~3.75 GB of "
+                         "params + Adam state)")
     ap.add_argument("--output", default=None,
                     help="write a JSON measurement record")
     args = ap.parse_args()
@@ -88,6 +93,7 @@ def main() -> None:
         remat=True,
         lm_head_chunks=args.lm_head_chunks,
         attention_window=args.window,
+        position_embedding=args.pos,
     )
     model = GPTModel(cfg)
     policy = amp.get_policy("O2")
@@ -170,6 +176,7 @@ def main() -> None:
                 "hidden": args.hidden, "layers": args.layers,
                 "lm_head_chunks": args.lm_head_chunks,
                 "window": args.window,
+                "position_embedding": args.pos,
                 "steps_timed": steps_timed,
                 "tokens_per_sec": round(tok_s, 1),
                 "loss_final": round(float(loss), 4),

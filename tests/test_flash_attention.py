@@ -608,7 +608,7 @@ def test_stream_auto_crossover_at_4k():
     assert not crossover and not wall  # model shapes stay resident
 
 
-def test_bias_past_crossover_keeps_resident_kernel():
+def test_bias_past_crossover_keeps_resident_kernel(monkeypatch):
     """Dense bias + the >= 4k crossover: the streamed path has no dbias
     pass, but the resident kernel COMPILES there (no VMEM wall) and
     beats dense XLA attention — auto must keep it rather than fall back
@@ -623,9 +623,20 @@ def test_bias_past_crossover_keeps_resident_kernel():
                    dtype=jnp.bfloat16)
     bias = jnp.zeros((B, 1, 4096, 4096))
     bias = bias.at[1, :, :, -64:].set(-10000.0)
-    out = flash_attention(q, k, v, bias, causal=True, impl="pallas",
-                          block_q=128, block_k=128)
     ref = mha_reference(q, k, v, bias, causal=True)
+    # the oracle below compares against mha_reference, so an XLA-fallback
+    # regression would pass trivially — assert the dispatch itself: the
+    # fallback must NOT run inside this flash_attention call
+    import apex_tpu.ops.flash_attention as fa
+
+    def no_fallback(*a, **kw):
+        raise AssertionError(
+            "crossover-only bias case fell back to mha_reference")
+
+    monkeypatch.setattr(fa, "mha_reference", no_fallback)
+    out = fa.flash_attention(q, k, v, bias, causal=True, impl="pallas",
+                             block_q=128, block_k=128)
+    monkeypatch.undo()
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=2e-2, atol=2e-2)
