@@ -60,7 +60,12 @@ def main() -> None:
     ap.add_argument("--lm-head-chunks", type=int, default=None,
                     help="chunked LM-head CE (at 32k tokens the full "
                          "(tokens, vocab) logits tensor alone is ~2 GB; "
-                         "chunking keeps the head's peak HBM flat)")
+                         "chunking keeps the head's peak HBM flat). "
+                         "Size chunks to >=16k tokens each: every chunk "
+                         "pays a read+write of the full dW_out gradient "
+                         "(h x vocab) in backward, so over-chunking is "
+                         "DMA-bound — measured at 1M tokens: 1024 chunks "
+                         "27k tok/s, 32 chunks 288k tok/s, same loss")
     ap.add_argument("--window", type=int, default=None,
                     help="sliding-window attention (GPTConfig."
                          "attention_window): O(s*window) attention cost "
