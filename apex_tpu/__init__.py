@@ -30,6 +30,10 @@ by XLA onto TPU:
                               resume (orbax or npz)
 - ``apex_tpu.pyprof``       — scopes/traces + XLA cost-model profiling
                               (reference: apex/pyprof/)
+- ``apex_tpu.monitor``      — runtime telemetry: step-metrics journal, HBM
+                              occupancy monitor, per-axis collective
+                              accounting, wedged-tunnel watchdog (no
+                              reference analog; extracted from bench.py)
 - ``apex_tpu.data``/``csrc``— host-side loaders; native C++ runtime pieces
 - ``apex_tpu.rnn``, ``apex_tpu.reparameterization`` — RNN zoo, weight norm
 """

@@ -30,7 +30,7 @@ from flax import struct
 
 from apex_tpu import precision as _precision
 from apex_tpu.amp.scaler import LossScaler
-from apex_tpu.ops.multi_tensor import tree_scale
+from apex_tpu.ops.multi_tensor import tree_l2norm, tree_scale
 from apex_tpu.optimizers._common import ClassOptimizer
 
 
@@ -72,12 +72,19 @@ class MixedPrecisionOptimizer:
         self,
         optimizer: Union[optax.GradientTransformation, ClassOptimizer],
         policy: _precision.Policy,
+        log_grad_norm: bool = False,
         **scaler_kwargs,
     ):
         self.inner = (
             optimizer.transform if isinstance(optimizer, ClassOptimizer) else optimizer
         )
         self.policy = policy
+        #: when True, ``apply_gradients`` metrics include the global L2 norm
+        #: of the unscaled grads — the journal hook (monitor/journal.py).
+        #: Off by default: the extra tree reduction, while small next to the
+        #: step's matmuls, must be opt-in so uninstrumented programs stay
+        #: byte-identical.
+        self.log_grad_norm = bool(log_grad_norm)
         self._scaler_kwargs = scaler_kwargs
 
     def init(self, model_params) -> MPOptState:
@@ -148,6 +155,10 @@ class MixedPrecisionOptimizer:
             "found_inf": found_inf,
             "loss_scale": new_scaler.loss_scale,
         }
+        if self.log_grad_norm:
+            # fp16_utils.FP16_Optimizer.step reports this unconditionally;
+            # here it rides the metrics dict only when asked for
+            metrics["grad_norm"] = tree_l2norm(grads32)
         return new_model, MPOptState(new_inner, new_master, new_scaler), metrics
 
     # -- checkpointing (apex/amp/frontend.py:361-400) -----------------------

@@ -1,0 +1,49 @@
+"""apex_tpu.monitor — runtime telemetry: journal, HBM, comms, watchdog.
+
+The framework's flagship evidence (PERF_NOTES.md) was produced by
+instrumentation hand-rolled inside ``bench.py``: per-stage checkpoints, a
+watchdog parent for the wedged-tunnel regime, OOM-ladder narration, and
+throughput windows timed with the device→host-fetch convention. This package
+extracts those patterns into a reusable subsystem any training loop
+(``bench.py``, ``examples/``, ``benchmarks/gpt_scaling.py``) can attach:
+
+- :mod:`journal` — :class:`MetricsJournal`: per-step JSON-lines records
+  (wall time, tokens/s, loss, global grad-norm, loss-scale state, cumulative
+  overflow counts) with rank info, honoring the tunnel timing discipline
+  (the clock stops on a device→host fetch, never bare ``block_until_ready``).
+- :mod:`hbm` — :class:`HBMMonitor`: ``jax.live_arrays()`` byte totals plus
+  lane-padded residency estimates (the T(8,128) layout tax documented in
+  ``ops/flash_attention.py``), so below-Python HBM accumulation and co-tenant
+  occupation become visible curves instead of postmortems.
+- :mod:`comms` — named scopes + byte counters for the collective verbs in
+  ``parallel/collectives.py`` and ``transformer/tensor_parallel/mappings.py``;
+  ``pyprof`` trace-joins then attribute measured comm seconds per mesh axis,
+  and :func:`comms.comm_accounting` tallies algorithmic bytes at trace time.
+- :mod:`watchdog` — the library-grade extraction of bench.py's watchdog
+  parent: a checkpoint-file + heartbeat-file protocol so any long-lived
+  process survives the wedged-tunnel regime (device calls that never return)
+  with its last per-stage record intact.
+- :mod:`selftest` — ``python -m apex_tpu.monitor.selftest``: fast off-TPU
+  smoke of all four pieces, wired into ``__graft_entry__.dryrun_multichip``.
+
+No reference-file citation: the reference (NVIDIA Apex) has no runtime
+telemetry layer; this subsystem generalizes bench.py's measurement
+discipline (bench.py module docstring, PERF_NOTES.md).
+"""
+
+from apex_tpu.monitor.comms import (  # noqa: F401
+    CommAccount,
+    collective_scope,
+    comm_accounting,
+)
+from apex_tpu.monitor.hbm import (  # noqa: F401
+    HBMMonitor,
+    lane_padded_bytes,
+    live_array_stats,
+)
+from apex_tpu.monitor.journal import MetricsJournal, scaler_state  # noqa: F401
+from apex_tpu.monitor.watchdog import (  # noqa: F401
+    Heartbeat,
+    WatchdogResult,
+    run_under_watchdog,
+)

@@ -1,0 +1,125 @@
+"""HBM occupancy monitor: live-array byte curves + lane-padded estimates.
+
+Two r4/r5 regimes motivated this (PERF_NOTES.md, CLAUDE.md gotchas): a
+long-lived process accumulates HBM *below* ``jax.live_arrays()`` through the
+axon tunnel (a config that OOMs at batch 1 runs fine in a fresh process),
+and co-tenant occupation makes placement fail while compute runs fine. Both
+were diagnosed postmortem from bench stderr; this module turns them into
+sampled curves: what Python CAN see (``jax.live_arrays()`` totals, padded
+and unpadded) over time, so the *visible* residency can be subtracted from
+an OOM to expose the below-Python remainder.
+
+Padded accounting: TPU HBM layouts tile the two minor dims — minor to the
+128-lane vreg width, second-minor to the sublane count for the dtype (8 for
+4-byte, 16 for 2-byte, 32 for 1-byte elements). A ``(b, h, sq, 1)`` f32
+operand therefore occupies 128x its ``nbytes`` at a custom-call boundary
+(2 GB for 16 MB of lse at 512k tokens — the measured tax that forced the
+streamed kernels' dense lse tables, ``ops/flash_attention.py``). The same
+rule is applied per live array here, as an estimate of placed footprint.
+
+All functions are host-side only: no device syncs, safe to call on the hot
+path after a step's loss fetch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_NUM_LANES = 128
+_SUBLANE_BYTES = 32  # sublanes x itemsize is constant: 8x4 = 16x2 = 32x1
+
+
+def lane_padded_bytes(shape, itemsize: int) -> int:
+    """Bytes of one array under TPU (sublane, lane) tiling.
+
+    Minor dim pads to 128 lanes; second-minor pads to ``32 // itemsize``
+    sublanes (f32: 8, bf16: 16, int8: 32). Rank-0/1 arrays are laid out as
+    a single (1, n) tile row.
+    """
+    itemsize = max(int(itemsize), 1)
+    dims = [int(d) for d in shape] or [1]
+    if len(dims) == 1:
+        dims = [1] + dims
+    sublanes = max(_SUBLANE_BYTES // itemsize, 1)
+    minor = -(-dims[-1] // _NUM_LANES) * _NUM_LANES
+    second = -(-dims[-2] // sublanes) * sublanes
+    n = minor * second
+    for d in dims[:-2]:
+        n *= d
+    return n * itemsize
+
+
+def live_array_stats(platform: Optional[str] = None) -> Dict[str, Any]:
+    """Snapshot of Python-visible device residency.
+
+    Returns ``{"live_bytes", "padded_bytes", "count", "largest_bytes"}``
+    summed over ``jax.live_arrays(platform)``. ``live_bytes`` counts logical
+    ``nbytes`` (global, for sharded arrays); ``padded_bytes`` applies the
+    lane/sublane tiling estimate per array. Deleted arrays report 0.
+    """
+    import jax
+
+    live = padded = largest = 0
+    count = 0
+    try:
+        arrays = jax.live_arrays(platform) if platform else jax.live_arrays()
+    except Exception:  # noqa: BLE001 - no backend yet
+        arrays = []
+    for a in arrays:
+        try:
+            if getattr(a, "is_deleted", lambda: False)():
+                continue
+            nb = int(a.nbytes)
+            pb = lane_padded_bytes(a.shape, a.dtype.itemsize)
+        except Exception:  # noqa: BLE001 - tokens/exotic avals
+            continue
+        live += nb
+        padded += pb
+        largest = max(largest, nb)
+        count += 1
+    return {"live_bytes": live, "padded_bytes": padded, "count": count,
+            "largest_bytes": largest}
+
+
+class HBMMonitor:
+    """Sampling monitor over :func:`live_array_stats`.
+
+    >>> mon = HBMMonitor(journal=journal)   # journal optional
+    >>> mon.sample("before")                # establishes the baseline
+    >>> ...training...
+    >>> mon.sample("after")
+    >>> mon.growth_bytes()                  # retained-leak detector
+
+    ``growth_bytes`` is last-sample minus baseline ``live_bytes``: a loop
+    that retains arrays (or exception tracebacks pinning device buffers —
+    the bench.py OOM-ladder trap) shows monotone growth; a healthy loop is
+    flat. The below-Python regime is the complement: an OOM whose ladder
+    rung exceeds HBM while ``growth_bytes`` stays ~0 means the occupation
+    is NOT Python-visible (fresh-process territory, bench.py stage 0).
+    """
+
+    def __init__(self, journal=None, label: str = ""):
+        self.journal = journal
+        self.label = label
+        self.samples = []
+
+    def sample(self, tag: str = "") -> Dict[str, Any]:
+        stats = live_array_stats()
+        stats["tag"] = tag
+        self.samples.append(stats)
+        if self.journal is not None:
+            self.journal.log(dict(stats, kind="hbm", label=self.label))
+        return stats
+
+    @property
+    def baseline(self) -> Optional[Dict[str, Any]]:
+        return self.samples[0] if self.samples else None
+
+    def growth_bytes(self) -> int:
+        """Python-visible residency growth since the first sample."""
+        if len(self.samples) < 2:
+            return 0
+        return self.samples[-1]["live_bytes"] - self.samples[0]["live_bytes"]
+
+    def peak_bytes(self) -> int:
+        return max((s["live_bytes"] for s in self.samples), default=0)

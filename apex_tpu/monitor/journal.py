@@ -1,0 +1,228 @@
+"""Step-metrics journal: per-step JSON-lines records for any training loop.
+
+Generalizes bench.py's measurement discipline (its module docstring and
+``_timed_windows``) into a reusable sink: every record carries wall time,
+throughput, loss, loss-scale state, grad norm, rank info, and (optionally)
+an HBM occupancy sample, one JSON object per line so any round's journal is
+greppable and machine-joinable with the BENCH record.
+
+Timing convention (CLAUDE.md tunnel discipline): the clock must stop on a
+device→host fetch of a value whose dependency chain covers the step — never
+on a bare ``block_until_ready`` (remote tunnels can ack dispatch rather than
+execution). :meth:`MetricsJournal.step_end` therefore takes the step's loss
+*array* and performs the ``float()`` fetch itself, so the recorded wall time
+includes device execution by construction.
+
+Zero hot-path syncs: the journal only touches device values after that loss
+fetch, when the device is already drained; everything else (file write, HBM
+sample via ``jax.live_arrays()``, rank lookup) is host-side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, IO, Optional, Union
+
+
+def _to_host(v):
+    """Best-effort scalar conversion for record values; non-scalars pass
+    through repr-able as-is (json.dumps(default=str) catches the rest)."""
+    try:
+        import numpy as np
+
+        if hasattr(v, "dtype") or isinstance(v, (np.generic,)):
+            arr = np.asarray(v)
+            if arr.size == 1:
+                x = arr.reshape(()).item()
+                return bool(x) if arr.dtype == bool else x
+            return arr.tolist()
+    except Exception:  # noqa: BLE001 - a journal write must never raise
+        pass
+    return v
+
+
+def scaler_state(scaler) -> Dict[str, Any]:
+    """Loss-scale state snapshot from an ``amp.scaler.LossScaler`` (the
+    same pytree the legacy ``fp16_utils.loss_scaler`` wrappers return):
+    scale value + clean-step counter. Host fetch of two scalars — call
+    after the step's loss fetch, not inside the timed region."""
+    return {
+        "loss_scale": _to_host(scaler.loss_scale),
+        "unskipped": _to_host(scaler.unskipped),
+    }
+
+
+class MetricsJournal:
+    """Append-only JSON-lines step journal.
+
+    >>> journal = MetricsJournal("out/train.jsonl", sample_hbm_every=10)
+    >>> for step in range(steps):
+    ...     journal.step_start()
+    ...     params, opt_state, loss, metrics = train_step(...)
+    ...     journal.step_end(step=step, loss=loss, tokens=batch * seq,
+    ...                      metrics=metrics, scaler=opt_state.scaler)
+    >>> journal.close()
+
+    ``metrics`` is the dict ``amp.MixedPrecisionOptimizer.apply_gradients``
+    returns (``found_inf``, ``loss_scale``, and ``grad_norm`` when built
+    with ``log_grad_norm=True``) or ``fp16_utils.FP16_Optimizer.step``'s
+    ``info``; its scalars are fetched post-barrier and flattened into the
+    record. Overflow/skip counts accumulate host-side from ``found_inf``.
+
+    Lines are written with ``O_APPEND`` semantics, so concurrent processes
+    (bench.py's fresh-subprocess phases) can share one journal file.
+    """
+
+    SCHEMA_VERSION = 1
+
+    #: field names every ``step`` record carries (tests assert round-trip)
+    STEP_FIELDS = ("v", "kind", "ts", "step", "wall_s", "rank", "rank_info")
+
+    def __init__(
+        self,
+        path_or_file: Union[str, IO[str]],
+        *,
+        meta: Optional[Dict[str, Any]] = None,
+        sample_hbm_every: int = 0,
+        flush_every: int = 1,
+    ):
+        if hasattr(path_or_file, "write"):
+            self._f, self._own = path_or_file, False
+            self.path = getattr(path_or_file, "name", None)
+        else:
+            d = os.path.dirname(os.path.abspath(path_or_file))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(path_or_file, "a")
+            self._own = True
+            self.path = path_or_file
+        self.sample_hbm_every = int(sample_hbm_every)
+        self.flush_every = max(int(flush_every), 1)
+        self._since_flush = 0
+        self._t0: Optional[float] = None
+        self._n = 0
+        self.overflows = 0  # cumulative found_inf count (skip counter)
+        if meta:
+            self.log(dict(meta, kind="meta"))
+
+    # -- rank info (utils/log_util.py's RankInfoFilter, journal-side) -------
+    @staticmethod
+    def _rank_fields() -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        try:
+            import jax
+
+            out["rank"] = jax.process_index()
+        except Exception:  # noqa: BLE001
+            out["rank"] = 0
+        try:
+            from apex_tpu.transformer import parallel_state
+
+            out["rank_info"] = parallel_state.get_rank_info_str()
+        except Exception:  # noqa: BLE001
+            out["rank_info"] = ""
+        return out
+
+    # -- core sink ----------------------------------------------------------
+    def log(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Write one record (any dict); fills ``v``/``kind``/``ts``/rank
+        fields, converts device scalars, never raises."""
+        rec = {"v": self.SCHEMA_VERSION, "kind": record.get("kind", "step"),
+               "ts": round(time.time(), 3)}
+        rec.update(self._rank_fields())
+        for k, v in record.items():
+            rec[k] = _to_host(v)
+        try:
+            self._f.write(json.dumps(rec, default=str) + "\n")
+            self._since_flush += 1
+            if self._since_flush >= self.flush_every:
+                self._f.flush()
+                self._since_flush = 0
+        except Exception:  # noqa: BLE001 - telemetry must not kill training
+            pass
+        return rec
+
+    # -- the step protocol --------------------------------------------------
+    def step_start(self) -> float:
+        self._t0 = time.perf_counter()
+        return self._t0
+
+    def step_end(
+        self,
+        *,
+        loss=None,
+        tokens: Optional[int] = None,
+        step: Optional[int] = None,
+        metrics: Optional[Dict[str, Any]] = None,
+        scaler=None,
+        wall_s: Optional[float] = None,
+        **extra,
+    ) -> Dict[str, Any]:
+        """Close the step opened by :meth:`step_start` and write its record.
+
+        The ``float(loss)`` here IS the execution barrier (tunnel
+        discipline): it stops the clock, so do not fetch the loss yourself
+        first. ``wall_s`` overrides the internal clock for callers (like
+        bench windows) that timed a multi-step region themselves.
+        """
+        loss_val = None
+        if loss is not None:
+            loss_val = float(loss)  # device→host fetch stops the clock
+        if wall_s is None:
+            wall_s = (time.perf_counter() - self._t0
+                      if self._t0 is not None else None)
+        self._t0 = None
+        rec: Dict[str, Any] = {"kind": "step", "wall_s": wall_s}
+        if step is not None:
+            rec["step"] = step
+        if loss_val is not None:
+            rec["loss"] = loss_val
+        if tokens is not None and wall_s:
+            rec["tokens"] = int(tokens)
+            rec["tokens_per_sec"] = round(tokens / wall_s, 1)
+        if metrics:
+            for k, v in metrics.items():
+                rec[k] = _to_host(v)
+            if rec.get("found_inf"):
+                self.overflows += 1
+        if scaler is not None:
+            rec.update(scaler_state(scaler))
+        rec["overflows"] = self.overflows
+        rec.update(extra)
+        self._n += 1
+        if self.sample_hbm_every and self._n % self.sample_hbm_every == 0:
+            try:
+                from apex_tpu.monitor.hbm import live_array_stats
+
+                rec["hbm"] = live_array_stats()
+            except Exception:  # noqa: BLE001
+                pass
+        return self.log(rec)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self):
+        try:
+            self._f.flush()
+            if self._own:
+                self._f.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @staticmethod
+    def read(path: str):
+        """Parse a journal back into a list of dicts (schema round-trip)."""
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out

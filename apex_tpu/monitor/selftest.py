@@ -1,0 +1,162 @@
+"""``python -m apex_tpu.monitor.selftest`` — fast off-TPU telemetry smoke.
+
+Proves, in seconds and on any backend (forced to CPU when run as a module),
+that the four monitor pieces stay importable and functional:
+
+1. journal: step records round-trip through JSON-lines with the required
+   schema fields (wall time, tokens/s, loss, loss-scale state, grad norm,
+   overflow counter, rank info, HBM sample);
+2. watchdog: a healthy child passes through; a deliberately-hung child is
+   killed at the deadline and its last checkpoint is recovered;
+3. hbm: a toy loop that retains arrays shows monotone visible growth, a
+   non-retaining loop stays flat;
+4. comms: traced collectives land in a :class:`CommAccount` keyed by axis.
+
+Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
+proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def _check_journal() -> dict:
+    import jax.numpy as jnp
+
+    from apex_tpu.monitor.journal import MetricsJournal
+
+    fd, path = tempfile.mkstemp(prefix="apex_tpu_journal_", suffix=".jsonl")
+    os.close(fd)
+    try:
+        with MetricsJournal(path, meta={"run": "selftest"},
+                            sample_hbm_every=1) as j:
+            for step in range(3):
+                j.step_start()
+                loss = jnp.asarray(2.5 - 0.1 * step, jnp.float32)
+                metrics = {"found_inf": jnp.asarray(step == 1),
+                           "loss_scale": jnp.asarray(2.0 ** 16, jnp.float32),
+                           "grad_norm": jnp.asarray(1.25, jnp.float32)}
+                j.step_end(step=step, loss=loss, tokens=4096, metrics=metrics)
+        rows = MetricsJournal.read(path)
+        steps = [r for r in rows if r["kind"] == "step"]
+        assert rows[0]["kind"] == "meta" and rows[0]["run"] == "selftest"
+        assert len(steps) == 3, rows
+        for field in ("wall_s", "loss", "tokens_per_sec", "loss_scale",
+                      "grad_norm", "overflows", "rank", "rank_info", "hbm"):
+            assert field in steps[-1], (field, steps[-1])
+        assert steps[-1]["overflows"] == 1  # the step-1 found_inf counted
+        assert steps[-1]["hbm"]["count"] >= 0
+        return {"ok": True, "records": len(rows)}
+    finally:
+        os.unlink(path)
+
+
+def _check_watchdog() -> dict:
+    from apex_tpu.monitor.watchdog import run_under_watchdog
+
+    # -S skips sitecustomize (which can import an accelerator plugin and
+    # take seconds) so the stub children start fast — bench.py test idiom
+    healthy = run_under_watchdog(
+        [sys.executable, "-S", "-c", "print('alive')"], deadline=30)
+    assert healthy.status == "ok" and healthy.returncode == 0, healthy
+    assert "alive" in healthy.stdout
+
+    hang = (
+        "import json, os, time\n"
+        "with open(os.environ['APEX_TPU_CHECKPOINT_PATH'], 'w') as f:\n"
+        "    json.dump({'stage': 'two', 'value': 7}, f)\n"
+        "time.sleep(60)\n"
+    )
+    hung = run_under_watchdog([sys.executable, "-S", "-c", hang],
+                              deadline=2, poll_s=0.1)
+    assert hung.status == "deadline", hung
+    assert hung.record == {"stage": "two", "value": 7}, hung.record
+    return {"ok": True, "hung_child_recovered_stage": hung.record["stage"]}
+
+
+def _check_hbm() -> dict:
+    import jax.numpy as jnp
+
+    from apex_tpu.monitor.hbm import HBMMonitor, lane_padded_bytes
+
+    # the T(8,128) layout tax: a (512, 1) f32 column pads 128x in lanes
+    assert lane_padded_bytes((512, 1), 4) == 512 * 128 * 4
+
+    leak = HBMMonitor()
+    leak.sample("baseline")
+    retained = []
+    for i in range(4):
+        retained.append(jnp.ones((256, 256), jnp.float32) * i)
+        leak.sample(f"iter{i}")
+    growth = leak.growth_bytes()
+    assert growth >= 4 * 256 * 256 * 4, growth
+
+    flat = HBMMonitor()
+    flat.sample("baseline")
+    for i in range(4):
+        _ = float(jnp.sum(jnp.ones((256, 256), jnp.float32)))
+        flat.sample(f"iter{i}")
+    assert abs(flat.growth_bytes()) < 256 * 256 * 4, flat.samples
+    del retained
+    return {"ok": True, "leak_growth_bytes": growth}
+
+
+def _check_comms() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.monitor.comms import comm_accounting
+    from apex_tpu.parallel import collectives
+
+    def fn(x):
+        y = collectives.psum(x, "i")
+        return collectives.pmean(y, "i")
+
+    x = jnp.ones((2, 8, 16), jnp.float32)
+    with comm_accounting() as acct:
+        # vmap binds the axis name without needing a mesh — trace only
+        jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(x)
+    per_axis = acct.by_axis()
+    expect = 8 * 16 * 4  # per-shard payload of each collective call site
+    assert per_axis["i"]["calls"] == 2, per_axis
+    assert per_axis["i"]["bytes"] == 2 * expect, per_axis
+    return {"ok": True, "by_axis": per_axis}
+
+
+def run() -> dict:
+    """In-process smoke (no platform mutation — safe under any backend)."""
+    results = {}
+    for name, fn in (("journal", _check_journal),
+                     ("watchdog", _check_watchdog),
+                     ("hbm", _check_hbm),
+                     ("comms", _check_comms)):
+        try:
+            results[name] = fn()
+        except Exception as e:  # noqa: BLE001 - report, don't crash the gate
+            results[name] = {"ok": False, "error": f"{type(e).__name__}: "
+                                                   f"{str(e)[:300]}"}
+    results["all_ok"] = all(v.get("ok") for v in results.values()
+                            if isinstance(v, dict))
+    return results
+
+
+def main() -> int:
+    # standalone runs must stay off any ambient accelerator plugin (the
+    # axon tunnel ignores JAX_PLATFORMS env; force in code, CLAUDE.md)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - backend already up: run on it
+        pass
+    results = run()
+    print(json.dumps({"monitor_selftest": results}))
+    return 0 if results["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""Library-grade watchdog: checkpoint + heartbeat protocol for wedged runs.
+
+Extracted from bench.py's watchdog parent (its ``_watchdog``/``checkpoint``
+pair): the r5 tunnel sessions showed a failure regime no in-process wrapper
+can catch — the device tunnel WEDGES and a device call simply never returns
+(a 4096x4096 matmul probe sat 10+ minutes; no OOM, no exception). Any
+long-lived process that owns evidence (a bench round, a training run with
+an in-memory metrics journal) must therefore run as a CHILD of a watchdog
+that can kill the whole process tree and surface the child's last durable
+state.
+
+Protocol (two small files, both written by the child):
+
+- **checkpoint file** (path in ``$APEX_TPU_CHECKPOINT_PATH``): a JSON
+  record the child overwrites after every completed stage — the "what we
+  know so far" the parent recovers when the child dies or hangs.
+- **heartbeat file** (path in ``$APEX_TPU_HEARTBEAT_PATH``): a tiny JSON
+  ``{"ts", "stage"}`` the child touches via :class:`Heartbeat` whenever it
+  makes progress. With ``stall_timeout`` set, the parent kills a child
+  whose heartbeat goes stale long before the hard deadline — distinguishing
+  "wedged" from "slow but alive" (a retry-heavy but HEALTHY round must not
+  be killed mid-stage; bench.py's deadline comment).
+
+The parent (:func:`run_under_watchdog`) spawns the child in its own session
+so a kill takes the WHOLE tree — the wedged device call usually lives in a
+grandchild, which a bare ``proc.kill()`` would orphan, leaving it pinning
+the chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+
+class Heartbeat:
+    """Child-side progress beacon (one JSON object, atomically replaced)."""
+
+    ENV = "APEX_TPU_HEARTBEAT_PATH"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_env(cls, var: Optional[str] = None) -> Optional["Heartbeat"]:
+        path = os.environ.get(var or cls.ENV)
+        return cls(path) if path else None
+
+    def beat(self, stage: str = "", record: Optional[Dict[str, Any]] = None):
+        """Record progress; never raises (telemetry must not kill work —
+        non-serializable record values stringify via ``default=str``)."""
+        payload = {"ts": time.time(), "stage": stage}
+        if record is not None:
+            payload["record"] = record
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=str)
+            os.replace(tmp, self.path)
+        except Exception:  # noqa: BLE001 - see docstring
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    @staticmethod
+    def read(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+class WatchdogResult(NamedTuple):
+    """Outcome of one supervised child run.
+
+    ``status``: ``"ok"`` (child exited by itself — inspect ``returncode``),
+    ``"deadline"`` (hard budget exceeded, tree killed), or ``"stalled"``
+    (heartbeat went stale past ``stall_timeout``, tree killed).
+    ``record`` is the child's last checkpoint (None if never written);
+    ``heartbeat`` its last beat. ``stdout`` is everything the child printed.
+    """
+
+    status: str
+    returncode: Optional[int]
+    stdout: str
+    record: Optional[Dict[str, Any]]
+    heartbeat: Optional[Dict[str, Any]]
+    reason: str
+
+
+def _kill_tree(proc: subprocess.Popen):
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except OSError:
+        proc.kill()
+    proc.wait()
+
+
+def run_under_watchdog(
+    cmd: List[str],
+    *,
+    deadline: float,
+    stall_timeout: Optional[float] = None,
+    checkpoint_env: str = "APEX_TPU_CHECKPOINT_PATH",
+    heartbeat_env: str = Heartbeat.ENV,
+    env: Optional[Dict[str, str]] = None,
+    poll_s: float = 0.25,
+) -> WatchdogResult:
+    """Run ``cmd`` under a hard deadline + optional heartbeat stall check.
+
+    The child finds its checkpoint/heartbeat paths in ``checkpoint_env`` /
+    ``heartbeat_env``; anything it durably wrote there survives a kill and
+    comes back in the result. stdout is drained on a thread (a full pipe
+    must not wedge the child — that would be the watchdog inventing the
+    failure mode it guards against); stderr passes through to the parent's.
+    """
+    fd, ckpt = tempfile.mkstemp(prefix="apex_tpu_ckpt_", suffix=".json")
+    os.close(fd)
+    os.unlink(ckpt)  # child creates it on first checkpoint
+    fd, hb_path = tempfile.mkstemp(prefix="apex_tpu_hb_", suffix=".json")
+    os.close(fd)
+    os.unlink(hb_path)
+    child_env = dict(os.environ if env is None else env)
+    child_env[checkpoint_env] = ckpt
+    child_env[heartbeat_env] = hb_path
+
+    start = time.time()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                            env=child_env, start_new_session=True)
+    chunks: List[str] = []
+
+    def _drain():
+        try:
+            for line in proc.stdout:
+                chunks.append(line)
+        except ValueError:
+            pass  # stream closed under us at kill time
+
+    reader = threading.Thread(target=_drain, daemon=True)
+    reader.start()
+
+    status, reason = "ok", ""
+    try:
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            now = time.time()
+            if now - start > deadline:
+                status = "deadline"
+                reason = (f"deadline {deadline:g}s exceeded "
+                          "(wedged tunnel?)")
+                _kill_tree(proc)
+                break
+            if stall_timeout is not None:
+                hb = Heartbeat.read(hb_path)
+                last = hb["ts"] if hb and "ts" in hb else start
+                if now - last > stall_timeout:
+                    stage = (hb or {}).get("stage", "<no beat yet>")
+                    status = "stalled"
+                    reason = (f"no heartbeat for {stall_timeout:g}s "
+                              f"(last stage: {stage})")
+                    _kill_tree(proc)
+                    break
+            time.sleep(poll_s)
+        reader.join(timeout=5)
+        return WatchdogResult(
+            status=status,
+            returncode=proc.returncode,
+            stdout="".join(chunks),
+            record=Heartbeat.read(ckpt),
+            heartbeat=Heartbeat.read(hb_path),
+            reason=reason,
+        )
+    finally:
+        for path in (ckpt, hb_path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def checkpoint_path(var: str = "APEX_TPU_CHECKPOINT_PATH") -> Optional[str]:
+    """Child-side accessor for the checkpoint file path (None when not
+    running under a watchdog)."""
+    return os.environ.get(var)
+
+
+def write_checkpoint(record: Dict[str, Any],
+                     var: str = "APEX_TPU_CHECKPOINT_PATH") -> bool:
+    """Child-side: persist the partial record; no-op without a watchdog.
+
+    Atomic (tmp + rename): a parent that kills this process mid-write must
+    never recover a truncated JSON; non-serializable values stringify."""
+    path = checkpoint_path(var)
+    if not path:
+        return False
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(record, f, default=str)
+        os.replace(tmp, path)
+        return True
+    except Exception:  # noqa: BLE001 - checkpointing must not kill work
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+if __name__ == "__main__":  # tiny manual harness: watchdog a shell command
+    rc_cmd = sys.argv[1:] or [sys.executable, "-c", "print('hello')"]
+    res = run_under_watchdog(rc_cmd, deadline=60, stall_timeout=None)
+    print(json.dumps({"status": res.status, "rc": res.returncode,
+                      "reason": res.reason}))

@@ -1464,6 +1464,14 @@ def _resident_vmem_bytes(sq, sk, d, blk_q, blk_k, itemsize, has_bias,
     return max(fwd, dkv)
 
 
+# Measurement basis of the stream='auto' throughput crossover: d=64 bf16
+# on-chip fwd+bwd. The re-streamed q/do rows move LANE-PADDED bytes
+# (minor dim pads to the 128-lane vreg width, same rule as
+# _resident_vmem_bytes), so the basis row is 128 lanes x 2 B = 256 B.
+_CROSSOVER_SEQ = 4096
+_CROSSOVER_ROW_BYTES = _NUM_LANES * 2
+
+
 def _auto_stream(sq, sk, d, blk_q, blk_k, itemsize, has_bias, has_seg):
     """The stream='auto' decision, shared with ``ring_attention``:
     ``(vmem_wall, crossover)``.
@@ -1473,12 +1481,25 @@ def _auto_stream(sq, sk, d, blk_q, blk_k, itemsize, has_bias, has_seg):
     ``crossover``: a measured THROUGHPUT boundary, not a memory wall: the
     resident dK/dV pass re-streams whole-sq q/do per k block (O(nk·sq·d)
     DMA) and falls behind the streamed layout past ~2k — on-chip fwd+bwd
-    d=64: s=2048 resident 12.2 vs streamed 13.4 ms, s=4096 resident 27.4
-    vs streamed 17.7 ms. (The dense lse tables made 4096-resident
-    COMPILE, so the wall check alone would pick the slower layout.)"""
+    AT d=64 bf16: s=2048 resident 12.2 vs streamed 13.4 ms, s=4096
+    resident 27.4 vs streamed 17.7 ms. (The dense lse tables made
+    4096-resident COMPILE, so the wall check alone would pick the slower
+    layout.) That re-streamed traffic moves PADDED rows — the minor dim
+    pads to 128 lanes, so every d <= 128 DMAs the same
+    ``128 * itemsize`` bytes/row and the measured 4096 boundary stands
+    across the whole d=32..128 bf16 family (a naive ``d * itemsize``
+    scaling would halve it for d=128 where the physical traffic is
+    unchanged). The boundary moves DOWN only when the padded row grows:
+    fp32 doubles it (any d <= 128 -> 2048), as does d > 128. The d=64
+    bf16 measurement is the only calibrated point; other (d, itemsize)
+    boundaries are this traffic-proportional extrapolation."""
     wall = _resident_vmem_bytes(sq, sk, d, blk_q, blk_k, itemsize,
                                 has_bias, has_seg) > _RESIDENT_VMEM_BUDGET
-    return wall, max(sq, sk) >= 4096
+    row_bytes = (-(-d // _NUM_LANES) * _NUM_LANES) * itemsize
+    crossover_seq = min(_CROSSOVER_SEQ,
+                        _CROSSOVER_SEQ * _CROSSOVER_ROW_BYTES
+                        // max(row_bytes, 1))
+    return wall, max(sq, sk) >= crossover_seq
 
 
 def mha_reference(

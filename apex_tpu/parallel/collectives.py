@@ -16,6 +16,12 @@ becomes ``ppermute`` ring shifts. All of these are only meaningful inside a
 Everything is a tree-map: apex's multi-tensor bucketing (flatten → NCCL →
 unflatten, distributed.py:425-475) exists to amortize launch overhead in
 eager CUDA; XLA already coalesces collectives, so a pytree maps directly.
+
+Telemetry: every verb runs under a ``comm:<verb>[<axis>]`` named scope
+(``apex_tpu.monitor.comms``), so pyprof trace-joins attribute measured comm
+seconds per mesh axis and ``monitor.comms.comm_accounting`` tallies payload
+bytes per (verb, axis) at trace time. Zero runtime cost: the scope exists
+only while tracing.
 """
 
 from __future__ import annotations
@@ -25,6 +31,8 @@ from typing import Any, Callable, Tuple, Union
 import jax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from apex_tpu.monitor.comms import collective_scope as _comm
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -42,39 +50,44 @@ def axis_size(axis: AxisNames) -> int:
 
 def psum(tree: Any, axis: AxisNames) -> Any:
     """All-reduce-sum over a mesh axis (dist.all_reduce SUM)."""
-    return lax.psum(tree, axis)
+    with _comm("psum", axis, tree):
+        return lax.psum(tree, axis)
 
 
 def pmean(tree: Any, axis: AxisNames) -> Any:
     """Averaging all-reduce — the DDP gradient reduction semantic
     (apex/parallel/distributed.py:449-457: allreduce then divide by
     world size)."""
-    return lax.pmean(tree, axis)
+    with _comm("pmean", axis, tree):
+        return lax.pmean(tree, axis)
 
 
 def pmax(tree: Any, axis: AxisNames) -> Any:
     """All-reduce-max (used by vocab-parallel cross entropy,
     tensor_parallel/cross_entropy.py:30-33, and overflow checks,
     transformer/amp/grad_scaler.py:25-36)."""
-    return jax.tree.map(lambda x: lax.pmax(x, axis), tree)
+    with _comm("pmax", axis, tree):
+        return jax.tree.map(lambda x: lax.pmax(x, axis), tree)
 
 
 def all_gather(tree: Any, axis: AxisNames, *, gather_axis: int = 0, tiled: bool = True) -> Any:
     """Gather shards along ``axis``, concatenating on ``gather_axis``
     (dist.all_gather + cat, tensor_parallel/mappings.py:61-70)."""
-    return jax.tree.map(
-        lambda x: lax.all_gather(x, axis, axis=gather_axis, tiled=tiled), tree
-    )
+    with _comm("all_gather", axis, tree):
+        return jax.tree.map(
+            lambda x: lax.all_gather(x, axis, axis=gather_axis, tiled=tiled), tree
+        )
 
 
 def reduce_scatter(tree: Any, axis: AxisNames, *, scatter_axis: int = 0) -> Any:
     """Sum-reduce then scatter shards along ``scatter_axis`` — the ZeRO grad
     primitive (contrib DistributedFusedAdam reduce-scatter pipeline,
     distributed_fused_adam.py:397-441)."""
-    return jax.tree.map(
-        lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True),
-        tree,
-    )
+    with _comm("reduce_scatter", axis, tree):
+        return jax.tree.map(
+            lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True),
+            tree,
+        )
 
 
 def ppermute_shift(tree: Any, axis: AxisNames, shift: int = 1) -> Any:
@@ -83,7 +96,8 @@ def ppermute_shift(tree: Any, axis: AxisNames, shift: int = 1) -> Any:
     (p2p_communication.py:29-67) and the transport for ring attention."""
     n = lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
-    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
+    with _comm("ppermute", axis, tree):
+        return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
 
 
 def broadcast(tree: Any, axis: AxisNames, src: int = 0) -> Any:
@@ -95,7 +109,8 @@ def broadcast(tree: Any, axis: AxisNames, src: int = 0) -> Any:
         # collective; avoids a host round-trip.
         return lax.all_gather(x, axis, axis=0, tiled=False)[src]
 
-    return jax.tree.map(_bcast, tree)
+    with _comm("broadcast", axis, tree):
+        return jax.tree.map(_bcast, tree)
 
 
 def all_to_all(
@@ -103,7 +118,8 @@ def all_to_all(
 ) -> jax.Array:
     """All-to-all reshard (basis of Ulysses-style sequence parallelism —
     absent in the reference, SURVEY.md §2.3 row SP)."""
-    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+    with _comm("all_to_all", axis, x):
+        return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
 
 
 # ---------------------------------------------------------------------------

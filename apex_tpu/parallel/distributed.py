@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.monitor.comms import collective_scope as _comm
 from apex_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_DATA, AXIS_PIPE
 
 AxisNames = Union[str, Tuple[str, ...]]
@@ -66,7 +67,11 @@ def allreduce_gradients(
             g = g * pre
         return g.astype(dt)
 
-    return jax.tree.map(_reduce, grads)
+    # one comm scope + byte tally over the whole grad tree: the DDP
+    # reduction is the dominant data-axis traffic, so the trace-join's
+    # per-axis comm attribution (monitor/comms.py) must see it
+    with _comm("grad_allreduce", axes, grads):
+        return jax.tree.map(_reduce, grads)
 
 
 def allreduce_gradients_by_spec(

@@ -188,6 +188,23 @@ def get_pipeline_model_parallel_split_rank() -> Optional[int]:
     return _STATE.pipeline_split_rank
 
 
+def get_rank_info_str() -> str:
+    """Topology suffix for log records and journal lines.
+
+    The reference formats a per-process (dp, tp, pp, vpp) rank tuple into
+    every log record (apex/transformer/log_util.py); under single-process
+    SPMD a process holds EVERY rank, so the honest per-process equivalent
+    is the mesh topology itself. ``utils.log_util.RankInfoFilter`` and
+    ``monitor.journal`` both consume this; empty when no mesh is installed.
+    """
+    if _STATE.mesh is None:
+        return ""
+    pp, dp, cp, tp = (_STATE.mesh.shape[a] for a in MESH_AXIS_NAMES)
+    vpp = _STATE.virtual_pipeline_world_size
+    return (f" mesh(pp{pp} dp{dp} cp{cp} tp{tp}"
+            f"{f' vpp{vpp}' if vpp else ''})")
+
+
 # -- virtual pipeline (interleaved schedule) state --------------------------
 # Mirrors parallel_state.py:367-382: the schedule sets the current model
 # chunk index while building/running the interleaved 1F1B loop.

@@ -20,6 +20,7 @@ from apex_tpu.parallel.mesh import (  # noqa: F401
     get_mesh,
     get_pipeline_model_parallel_split_rank,
     get_pipeline_model_parallel_world_size,
+    get_rank_info_str,
     get_tensor_model_parallel_world_size,
     get_virtual_pipeline_model_parallel_rank,
     get_virtual_pipeline_model_parallel_world_size,

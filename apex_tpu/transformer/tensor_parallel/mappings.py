@@ -26,6 +26,7 @@ from functools import partial
 import jax
 from jax import lax
 
+from apex_tpu.monitor.comms import collective_scope as _comm
 from apex_tpu.parallel.mesh import AXIS_MODEL
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
@@ -51,7 +52,8 @@ def _copy_fwd(x, axis):
 
 
 def _copy_bwd(axis, _, g):
-    return (lax.psum(g, axis),)
+    with _comm("psum", axis, g):
+        return (lax.psum(g, axis),)
 
 
 copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
@@ -61,11 +63,13 @@ copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
 def reduce_from_tensor_model_parallel_region(x, axis: str = AXIS_MODEL):
     """All-reduce forward, identity backward (_ReduceFromModelParallelRegion,
     mappings.py:36-46). Applied to the output of a row-parallel linear."""
-    return lax.psum(x, axis)
+    with _comm("psum", axis, x):
+        return lax.psum(x, axis)
 
 
 def _reduce_fwd(x, axis):
-    return lax.psum(x, axis), None
+    with _comm("psum", axis, x):
+        return lax.psum(x, axis), None
 
 
 def _reduce_bwd(axis, _, g):
@@ -87,7 +91,8 @@ def _scatter_fwd(x, axis):
 
 
 def _scatter_bwd(axis, _, g):
-    return (lax.all_gather(g, axis, axis=g.ndim - 1, tiled=True),)
+    with _comm("all_gather", axis, g):
+        return (lax.all_gather(g, axis, axis=g.ndim - 1, tiled=True),)
 
 
 scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
@@ -98,11 +103,13 @@ def gather_from_tensor_model_parallel_region(x, axis: str = AXIS_MODEL):
     """All-gather on the last dim forward, slice backward
     (_GatherFromModelParallelRegion, mappings.py:62-72). The sliced backward
     encodes Megatron's replicated-downstream convention — see module doc."""
-    return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+    with _comm("all_gather", axis, x):
+        return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
 
 
 def _gather_fwd(x, axis):
-    return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True), None
+    with _comm("all_gather", axis, x):
+        return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True), None
 
 
 def _gather_bwd(axis, _, g):

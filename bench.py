@@ -53,6 +53,15 @@ device seconds for the real 345M step (pyprof trace-join, VERDICT r4 ask
 canary program whose TF/s is recorded alongside them, so cross-round
 drift in those single-config rungs is attributable to co-tenant load
 (VERDICT r4 ask #6).
+
+Telemetry (r6): the watchdog/checkpoint machinery is the library's now
+(apex_tpu/monitor/watchdog.py — this file adapts it and adds a heartbeat
+beat per stage; BENCH_STALL arms the stale-heartbeat kill). Setting
+BENCH_JOURNAL=<path> makes every timed window, across all subprocess
+phases, append one JSON-lines record (wall time, tok/s, loss, loss-scale
+state, grad-norm, HBM occupancy sample) to that file via
+apex_tpu.monitor.MetricsJournal; unset, the compiled programs are
+byte-identical to un-instrumented rounds.
 """
 
 from __future__ import annotations
@@ -73,6 +82,37 @@ import jax.numpy as jnp
 import numpy as np
 
 WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
+
+# process-global step journal (apex_tpu.monitor.journal), armed by
+# BENCH_JOURNAL=<path>. Subprocess phases inherit the env, so every stage
+# appends (O_APPEND, one JSON object per line) to ONE shared journal file;
+# False means "tried and failed, stay off".
+_JOURNAL = None
+
+
+def _get_journal():
+    global _JOURNAL
+    path = os.environ.get("BENCH_JOURNAL")
+    if not path:
+        return None
+    if _JOURNAL is None:
+        try:
+            from apex_tpu.monitor.journal import MetricsJournal
+
+            _JOURNAL = MetricsJournal(path, sample_hbm_every=1)
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill bench
+            print(f"bench journal disabled: {e}", file=sys.stderr)
+            _JOURNAL = False
+    return _JOURNAL or None
+
+
+def _state_metrics(state):
+    """Metrics getter for journaled GPT runs: ``_prepare`` appends the last
+    step's metrics dict (loss_scale/found_inf/grad_norm) as ``state[3]``
+    only when the journal is armed."""
+    if len(state) > 3:
+        return lambda: state[3]
+    return None
 
 
 def _stats(rates):
@@ -99,14 +139,23 @@ def _is_oom(e: Exception) -> bool:
     return False
 
 
-def _timed_windows(advance, get_loss, *, steps, windows, per_window_units):
+def _timed_windows(advance, get_loss, *, steps, windows, per_window_units,
+                   label="", get_metrics=None):
     """The shared window-timing protocol: warmup happened already (caller
     ran one step/chunk and fetched); each window runs ``advance()``
     ``steps`` times, then stops the clock on a device→host fetch of the
     loss (whose dependency chain covers every step — tunnel discipline,
-    PERF_NOTES.md). Returns per-window rates in ``per_window_units/s``."""
+    PERF_NOTES.md). Returns per-window rates in ``per_window_units/s``.
+
+    With BENCH_JOURNAL armed, each window lands one journal record (wall
+    time, units/s, loss, the step metrics from ``get_metrics``, an HBM
+    sample) AFTER the loss fetch — the device is drained, so the journal
+    adds zero syncs to the timed region. The recorded loss is exactly the
+    value the barrier fetched: for the GPT rungs that is the SCALED loss
+    (divide by the record's ``loss_scale`` for a comparable curve)."""
     rates = []
-    for _ in range(windows):
+    journal = _get_journal()
+    for i in range(windows):
         t0 = time.perf_counter()
         for _ in range(steps):
             advance()
@@ -114,6 +163,11 @@ def _timed_windows(advance, get_loss, *, steps, windows, per_window_units):
         dt = time.perf_counter() - t0
         assert jnp.isfinite(loss_val), "non-finite loss in bench"
         rates.append(per_window_units / dt)
+        if journal is not None:
+            journal.step_end(
+                loss=loss_val, wall_s=dt, tokens=per_window_units,
+                metrics=(get_metrics() if get_metrics else None),
+                label=label or "window", window=i, steps=steps)
     return rates
 
 
@@ -161,7 +215,12 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
     model = GPTModel(cfg)
     policy = amp.get_policy(policy_level)
     opt = FusedAdam(lr=1e-4) if fused else optax.adam(1e-4)
-    mp_opt = amp.MixedPrecisionOptimizer(opt, policy)
+    # grad-norm in the step metrics only when the journal is armed: the
+    # extra tree reduction is noise next to the step's matmuls, but the
+    # un-journaled headline program must stay byte-identical to pre-journal
+    # rounds so cross-round deltas attribute to code under test
+    mp_opt = amp.MixedPrecisionOptimizer(
+        opt, policy, log_grad_norm=bool(os.environ.get("BENCH_JOURNAL")))
     params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
     opt_state = mp_opt.init(params)
 
@@ -195,24 +254,35 @@ def _prepare(step, params, opt_state, batch, seq, steps=10, scan_chunk=4):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 50304)
     targets = jnp.roll(tokens, -1, axis=-1)
 
+    # journal armed: the chunk also returns the LAST step's metrics dict
+    # (loss_scale/found_inf/grad_norm — three scalars already computed by
+    # the step) so windows can journal loss-scale state without a second
+    # program. Un-journaled programs keep the exact pre-journal outputs.
+    journaled = bool(os.environ.get("BENCH_JOURNAL"))
     if scan_chunk > 1:
 
         @jax.jit
         def run_chunk(params, opt_state, tokens, targets):
             def body(carry, _):
                 p, s = carry
-                p, s, loss, _ = step(p, s, tokens, targets)
-                return (p, s), loss
+                p, s, loss, m = step(p, s, tokens, targets)
+                return (p, s), ((loss, m) if journaled else loss)
 
-            (params, opt_state), losses = lax.scan(
+            (params, opt_state), ys = lax.scan(
                 body, (params, opt_state), None, length=scan_chunk)
-            return params, opt_state, losses[-1]
+            if journaled:
+                losses, ms = ys
+                return (params, opt_state, losses[-1],
+                        jax.tree.map(lambda x: x[-1], ms))
+            return params, opt_state, ys[-1]
 
     else:
 
         @jax.jit
         def run_chunk(params, opt_state, tokens, targets):
-            p, s, loss, _ = step(params, opt_state, tokens, targets)
+            p, s, loss, m = step(params, opt_state, tokens, targets)
+            if journaled:
+                return p, s, loss, m
             return p, s, loss
 
     # round the requested step count up to whole chunks (never time fewer
@@ -325,7 +395,9 @@ def measure_resilient(level, impl, batch, seq, steps, windows=WINDOWS,
             retries=retries, retry_sleep=retry_sleep)
         try:
             rates = _timed_windows(advance, get_loss, steps=n_chunks,
-                                   windows=windows, per_window_units=units)
+                                   windows=windows, per_window_units=units,
+                                   label=f"gpt_{level}",
+                                   get_metrics=_state_metrics(_state))
             return rates, batch, rung
         except Exception as e:  # noqa: BLE001
             if not _is_oom(e) or batch <= 1:
@@ -358,7 +430,9 @@ def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
     # while O2 bf16 runs fine)
     solo2 = dict(_stats(_timed_windows(prep2[0], prep2[1], steps=prep2[2],
                                        windows=windows,
-                                       per_window_units=prep2[3])),
+                                       per_window_units=prep2[3],
+                                       label="gpt_O2",
+                                       get_metrics=_state_metrics(prep2[4]))),
                  rung=rung2)
     interleaved = True
     prep0 = None
@@ -414,9 +488,11 @@ def gpt_headline(batch, seq, steps, windows=WINDOWS, hidden=None, layers=None):
     try:
         for _ in range(windows):
             rates2 += _timed_windows(adv2, loss2, steps=n2, windows=1,
-                                     per_window_units=u2)
+                                     per_window_units=u2, label="gpt_O2",
+                                     get_metrics=_state_metrics(_s2))
             rates0 += _timed_windows(adv0, loss0, steps=n0, windows=1,
-                                     per_window_units=u0)
+                                     per_window_units=u0, label="gpt_O0",
+                                     get_metrics=_state_metrics(_s0))
     except Exception as e:  # noqa: BLE001
         if not _is_oom(e):
             raise
@@ -531,7 +607,8 @@ def bench_resnet50(batch=None, steps=10, windows=WINDOWS):
         float(state[3])  # compile + execute barrier
         rates = _timed_windows(advance, lambda: state[3], steps=steps,
                                windows=windows,
-                               per_window_units=batch * steps)
+                               per_window_units=batch * steps,
+                               label="resnet50")
         return dict(_stats(rates), batch=batch)
 
     return _oom_halving(run, batch, min_batch=4, label="resnet50")
@@ -600,7 +677,8 @@ def bench_bert_lamb(batch=None, steps=10, windows=WINDOWS):
         float(state[2])
         rates = _timed_windows(advance, lambda: state[2], steps=steps,
                                windows=windows,
-                               per_window_units=batch * seq * steps)
+                               per_window_units=batch * seq * steps,
+                               label="bert")
         return dict(_stats(rates), batch=batch, unroll=unroll)
 
     def run(batch):
@@ -958,22 +1036,32 @@ def main():
         "vs_baseline": None,
     }
     errors = {}
+    try:
+        from apex_tpu.monitor.watchdog import Heartbeat, write_checkpoint
 
-    def checkpoint():
-        """Persist the partial record after every stage: when the tunnel
-        WEDGES (observed r5: even a 4k matmul never returns — no
+        hb = Heartbeat.from_env("BENCH_HEARTBEAT_PATH")
+    except Exception:  # noqa: BLE001 - telemetry import must not kill bench
+        hb = None
+        write_checkpoint = lambda *a, **k: False  # noqa: E731
+
+    def checkpoint(stage_name="checkpoint"):
+        """Persist the partial record after every stage (the library's
+        atomic checkpoint-file protocol, monitor/watchdog.py): when the
+        tunnel WEDGES (observed r5: even a 4k matmul never returns — no
         exception, nothing to catch), the watchdog parent kills this
-        process and prints the last checkpoint instead of nothing."""
-        path = os.environ.get("BENCH_PARTIAL_PATH")
-        if path:
-            rec = dict(result)
-            if errors:
-                rec["errors"] = dict(errors)
-            try:
-                with open(path, "w") as f:
-                    json.dump(rec, f)
-            except OSError:
-                pass
+        process and prints the last checkpoint instead of nothing. Also
+        beats the heartbeat so a parent running with BENCH_STALL can tell
+        wedged from slow-but-alive."""
+        rec = dict(result)
+        if errors:
+            rec["errors"] = dict(errors)
+        write_checkpoint(rec, var="BENCH_PARTIAL_PATH")
+        if hb is not None:
+            hb.beat(stage_name)
+
+    # first beat BEFORE any work: the stall clock must start from "alive
+    # at t=0", not from the first completed stage
+    checkpoint("start")
 
     def stage(key, fn):
         """Run one evidence stage; on failure record the error and move on.
@@ -981,6 +1069,8 @@ def main():
         are truly returned before the next stage allocates."""
         import gc
 
+        if hb is not None:
+            hb.beat(f"{key}:start")
         try:
             result[key] = fn()
             print(f"{key}: {result[key]}", file=sys.stderr)
@@ -991,7 +1081,7 @@ def main():
             return None
         finally:
             gc.collect()
-            checkpoint()
+            checkpoint(key)
 
     try:
         # 0. the GPT headline — FIRST, each phase in a FRESH SUBPROCESS
@@ -1012,6 +1102,12 @@ def main():
             deadline_at = float(os.environ.get("BENCH_DEADLINE_AT", "inf"))
             remaining = deadline_at - time.time() - 120
             timeout = max(60, min(timeout, remaining))
+            if hb is not None:
+                # one beat per subprocess phase: these are the longest
+                # silent stretches (up to 2700 s), and each carries its
+                # own timeout, so "alive at phase entry" is the honest
+                # stall signal while it runs
+                hb.beat(f"{flag}:start")
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), flag],
                 capture_output=True, text=True, timeout=timeout,
@@ -1180,9 +1276,12 @@ def main():
 
 
 def _watchdog(cmd=None, env_extra=None):
-    """Run ``main()`` in a CHILD process under a hard deadline and print
-    ITS json line — or, if the child hangs past the deadline, kill it and
-    print the partial record it checkpointed after every stage.
+    """Run ``main()`` in a CHILD process under the library watchdog
+    (apex_tpu/monitor/watchdog.py — this pattern's extraction, r6) and
+    print ITS json line — or, if the child hangs past the deadline, dies
+    silently, or (with BENCH_STALL set) stops beating its heartbeat, kill
+    the whole tree and print the partial record it checkpointed after
+    every stage.
 
     Why: the r5 sessions showed a failure mode the stage wrappers cannot
     catch — the tunnel WEDGES and a device call simply never returns (a
@@ -1191,64 +1290,48 @@ def _watchdog(cmd=None, env_extra=None):
     end with no JSON line at all. The subprocess phases already carry
     their own timeouts; this covers the parent's in-process stages.
     ``cmd``/``env_extra`` exist for the unit test (a stub child)."""
-    import signal
-    import subprocess
-    import tempfile
+    from apex_tpu.monitor.watchdog import run_under_watchdog
 
-    # must exceed the worst-case SUM of the child's own subprocess
-    # timeouts (headline 2700 + degraded 2700 + o0 1800 + profile 1200 =
-    # 8400 s) plus the in-process stages — a retry-heavy but HEALTHY round
-    # must not be killed mid-stage. run_sub additionally caps each
-    # subprocess timeout to the remaining budget via BENCH_DEADLINE_AT.
+    # the hard deadline must exceed the worst-case SUM of the child's own
+    # subprocess timeouts (headline 2700 + degraded 2700 + o0 1800 +
+    # profile 1200 = 8400 s) plus the in-process stages — a retry-heavy
+    # but HEALTHY round must not be killed mid-stage. run_sub additionally
+    # caps each subprocess timeout to the remaining budget via
+    # BENCH_DEADLINE_AT. BENCH_STALL (seconds, default off) arms the
+    # faster heartbeat check: main() beats at start, at every stage
+    # entry/checkpoint, and before each subprocess phase — but a phase is
+    # SILENT while it runs, so BENCH_STALL must exceed the longest single
+    # stage (the 2700 s headline subprocess), or a healthy round gets
+    # killed mid-phase.
     deadline = int(os.environ.get("BENCH_DEADLINE", "10800"))
-    fd, partial = tempfile.mkstemp(prefix="bench_partial_", suffix=".json")
-    os.close(fd)
-    env = dict(os.environ, BENCH_WATCHDOG="0", BENCH_PARTIAL_PATH=partial,
+    stall = os.environ.get("BENCH_STALL")
+    env = dict(os.environ, BENCH_WATCHDOG="0",
                BENCH_DEADLINE_AT=str(time.time() + deadline))
     env.update(env_extra or {})
-    cmd = cmd or [sys.executable, os.path.abspath(__file__)]
-    # own session/process group: on timeout the WHOLE tree dies — the
-    # wedged device call usually lives in a run_sub grandchild, which a
-    # bare proc.kill() would orphan, leaving it pinning the chip
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True, env=env,
-                            start_new_session=True)
-
-    def recover(reason):
-        try:
-            with open(partial) as f:
-                rec = json.load(f)
-        except Exception:  # noqa: BLE001 - nothing checkpointed yet
-            rec = {"metric": "gpt2_345m_o2_train_tokens_per_sec",
-                   "value": None, "unit": "tokens/s", "vs_baseline": None}
-        rec.setdefault("errors", {})["watchdog"] = (
-            reason + "; printing the last per-stage checkpoint")
-        print(json.dumps(rec))
+    res = run_under_watchdog(
+        cmd or [sys.executable, os.path.abspath(__file__)],
+        deadline=deadline,
+        stall_timeout=float(stall) if stall else None,
+        checkpoint_env="BENCH_PARTIAL_PATH",
+        heartbeat_env="BENCH_HEARTBEAT_PATH",
+        env=env,
+    )
+    lines = (res.stdout or "").strip().splitlines()
+    if res.status == "ok" and lines and lines[-1].lstrip().startswith("{"):
+        sys.stdout.write(res.stdout)
         return 0
-
-    try:
-        try:
-            out, _ = proc.communicate(timeout=deadline)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except OSError:
-                proc.kill()
-            proc.wait()
-            return recover(f"deadline {deadline}s exceeded (wedged "
-                           "tunnel?)")
-        lines = (out or "").strip().splitlines()
-        if lines and lines[-1].lstrip().startswith("{"):
-            sys.stdout.write(out)
-            return 0
-        # the child DIED without a record (segfault/abort in the native
-        # plugin — same failure family as the wedge): recover the partial
-        return recover(f"child exited rc={proc.returncode} with no JSON "
-                       "line")
-    finally:
-        try:
-            os.unlink(partial)
-        except OSError:
-            pass
+    # killed (wedge/stall), or the child DIED without a record (segfault/
+    # abort in the native plugin — same failure family): recover the
+    # last per-stage checkpoint so the round still has a JSON line
+    rec = res.record or {"metric": "gpt2_345m_o2_train_tokens_per_sec",
+                         "value": None, "unit": "tokens/s",
+                         "vs_baseline": None}
+    reason = res.reason or (f"child exited rc={res.returncode} with no "
+                            "JSON line")
+    rec.setdefault("errors", {})["watchdog"] = (
+        reason + "; printing the last per-stage checkpoint")
+    print(json.dumps(rec))
+    return 0
 
 
 if __name__ == "__main__":

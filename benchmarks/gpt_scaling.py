@@ -113,9 +113,14 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         # impossible in this environment, so multi-chip readiness is argued
         # from the compiled HLO — async collective pairs (*-start/*-done
         # with instructions scheduled between them) are what lets XLA hide
-        # the pipeline ring / TP allreduces behind compute on ICI.
-        overlap = _overlap_evidence(
-            train_step.lower(params, opt_state, toks, tgts).compile())
+        # the pipeline ring / TP allreduces behind compute on ICI. The
+        # comm_accounting context rides the same trace: every collective
+        # call site tallies payload bytes per mesh axis (monitor/comms.py).
+        from apex_tpu.monitor.comms import comm_accounting
+
+        with comm_accounting() as comm_acct:
+            lowered = train_step.lower(params, opt_state, toks, tgts)
+        overlap = _overlap_evidence(lowered.compile())
 
         params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
         float(loss)  # compile + execute barrier
@@ -133,6 +138,9 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             "tokens_per_sec": round(batch * seq / dt, 1),
             "loss": round(loss_val, 4),
             "overlap": overlap,
+            # traced payload bytes per mesh axis (per traced call site —
+            # scanned sites count once; see monitor/comms.py)
+            "comm_bytes_by_axis": comm_acct.by_axis(),
         }
     finally:
         mesh_lib.destroy_model_parallel()

@@ -66,6 +66,11 @@ def parse_args():
     p.add_argument("--data", default=None, help="dir of .bin int32 token files")
     p.add_argument("--save-dir", default=None)
     p.add_argument("--save-every", type=int, default=100)
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="write a per-step JSON-lines metrics journal "
+                        "(apex_tpu.monitor: wall time, tokens/s, loss, "
+                        "grad-norm, loss-scale state, HBM samples); adds "
+                        "one loss fetch per step")
     return p.parse_args()
 
 
@@ -95,7 +100,9 @@ def main():
     )
     model = GPTModel(cfg)
     policy = amp.get_policy(args.opt_level)
-    mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=args.lr), policy)
+    # journaled runs also want the global grad-norm in the step metrics
+    mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=args.lr), policy,
+                                         log_grad_norm=bool(args.journal))
 
     full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
     all_specs = model.specs()
@@ -169,11 +176,28 @@ def main():
         start = step
         print(f"resumed from step {step}")
 
+    journal = None
+    if args.journal:
+        from apex_tpu.monitor import MetricsJournal
+
+        journal = MetricsJournal(
+            args.journal, sample_hbm_every=10,
+            meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
+                  "dp": dp, "hidden": args.hidden, "layers": args.layers,
+                  "seq": args.seq, "batch": batch})
+
     t0 = time.perf_counter()
     for i in range(start, start + args.steps):
         toks, tgts = next_batch()
+        if journal is not None:
+            journal.step_start()
         params, opt_state, loss, metrics = train_step(
             params, opt_state, shard(toks), shard(tgts))
+        if journal is not None:
+            # the journal's float(loss) IS the step's execution barrier
+            # (tunnel discipline); metrics/scaler fetches ride after it
+            journal.step_end(step=i, loss=loss, tokens=batch * args.seq,
+                             metrics=metrics, scaler=opt_state.scaler)
         if i == start:
             float(loss)  # exclude compile
             t0 = time.perf_counter()
@@ -183,6 +207,8 @@ def main():
         if args.save_dir and (i + 1) % args.save_every == 0:
             checkpoint.save_checkpoint(
                 args.save_dir, i + 1, {"params": params, "opt": opt_state})
+    if journal is not None:
+        journal.close()
     n_done = max(args.steps - 1, 1)
     dt = (time.perf_counter() - t0) / n_done
     print(f"{batch * args.seq / dt:.0f} tokens/s | mesh: tp={args.tp} pp={args.pp} "

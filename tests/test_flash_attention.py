@@ -569,7 +569,13 @@ def test_window_restricted_streamed_grid(causal):
 def test_window_restricted_grid_with_segments(contiguous):
     """Restricted windowed grid + segment ids: the remapped kmap/qmap
     BlockSpecs must fetch the RIGHT id blocks and metadata (sq=512,
-    window=32, blk 64/128 -> restricted), kernel vs XLA, fwd + grads."""
+    window=32, blk 64/128 -> restricted), kernel vs XLA, fwd + grads.
+
+    Grads over argnums=(0, 1, 2): dq exercises the remapped dQ pass, but
+    dk/dv come from the SEPARATE streamed dK/dV pass, whose qmap remap
+    (which q trips each k block sees under the window restriction) the
+    dq assertion cannot catch (ADVICE finding: a qmap-remap bug slipped
+    through while only dq was value-asserted)."""
     from apex_tpu.ops.flash_attention import _window_grid
 
     assert _window_grid(64, 128, 4, True, 32) is not None
@@ -583,13 +589,16 @@ def test_window_restricted_grid_with_segments(contiguous):
     out_x = flash_attention(q, k, v, impl="xla", **kw)
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_x),
                                rtol=2e-5, atol=2e-5)
-    gs = jax.grad(lambda q: jnp.sum(flash_attention(
+    gs = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
         q, k, v, stream="always", impl="pallas", block_q=64, block_k=128,
-        contiguous_segments=contiguous, **kw) ** 2))(q)
-    gx = jax.grad(lambda q: jnp.sum(flash_attention(
-        q, k, v, impl="xla", **kw) ** 2))(q)
-    np.testing.assert_allclose(np.asarray(gs), np.asarray(gx),
-                               rtol=1e-4, atol=1e-4)
+        contiguous_segments=contiguous, **kw) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, impl="xla", **kw) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), gs, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"{name} mismatch")
 
 
 def test_stream_auto_crossover_at_4k():
@@ -606,6 +615,38 @@ def test_stream_auto_crossover_at_4k():
     wall, crossover = _auto_stream(2048, 2048, 64, 1024, 1024, 2,
                                    False, False)
     assert not crossover and not wall  # model shapes stay resident
+
+
+def test_stream_auto_crossover_scales_with_row_bytes():
+    """The crossover was MEASURED at d=64 bf16; the resident dK/dV DMA
+    bill moves LANE-PADDED rows (minor dim pads to 128 lanes — the same
+    rule _resident_vmem_bytes counts), so every d <= 128 bf16 shares the
+    measured 4096 boundary, and the boundary halves only when the padded
+    row actually doubles: fp32 itemsize, or d > 128 (ADVICE finding: the
+    scaling must be documented against its d=64 measurement basis, not
+    guessed from unpadded arithmetic)."""
+    from apex_tpu.ops.flash_attention import _auto_stream
+
+    # the whole d=32..128 bf16 family DMAs identical 256 B padded rows:
+    # one measured boundary, 4096
+    for d in (32, 64, 128):
+        _, crossover = _auto_stream(2048, 2048, d, 1024, 1024, 2,
+                                    False, False)
+        assert not crossover, d
+        _, crossover = _auto_stream(4096, 4096, d, 1024, 1024, 2,
+                                    False, False)
+        assert crossover, d
+    # fp32 doubles the padded row -> boundary halves to 2048
+    _, crossover = _auto_stream(2048, 2048, 64, 1024, 1024, 4,
+                                False, False)
+    assert crossover
+    _, crossover = _auto_stream(1024, 1024, 64, 1024, 1024, 4,
+                                False, False)
+    assert not crossover
+    # d=256 bf16: two padded lanes-groups per row -> 2048 as well
+    _, crossover = _auto_stream(2048, 2048, 256, 1024, 1024, 2,
+                                False, False)
+    assert crossover
 
 
 def test_bias_past_crossover_keeps_resident_kernel(monkeypatch):

@@ -1,0 +1,408 @@
+"""Tests for apex_tpu.monitor — journal schema round-trip, HBM leak
+detection, collective byte accounting + trace-join scope attribution, the
+library watchdog (hung child killed, checkpoint recovered, heartbeat
+stall), and the bench.py/amp integration hooks. All CPU-mesh safe (the
+conftest forces 8 virtual CPU devices)."""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.monitor import (
+    Heartbeat,
+    HBMMonitor,
+    MetricsJournal,
+    comm_accounting,
+    lane_padded_bytes,
+    live_array_stats,
+    run_under_watchdog,
+    scaler_state,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_schema_round_trip(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with MetricsJournal(path, meta={"run": "t"}, sample_hbm_every=2) as j:
+        for step in range(4):
+            j.step_start()
+            loss = jnp.asarray(1.0 / (step + 1), jnp.float32)
+            metrics = {"found_inf": jnp.asarray(step == 2),
+                       "loss_scale": jnp.asarray(65536.0, jnp.float32),
+                       "grad_norm": jnp.asarray(0.5, jnp.float32)}
+            rec = j.step_end(step=step, loss=loss, tokens=1024,
+                             metrics=metrics)
+            assert rec["wall_s"] >= 0
+    rows = MetricsJournal.read(path)
+    assert rows[0]["kind"] == "meta" and rows[0]["run"] == "t"
+    steps = [r for r in rows if r["kind"] == "step"]
+    assert [r["step"] for r in steps] == [0, 1, 2, 3]
+    last = steps[-1]
+    # the required record surface: time, throughput, loss, scale state,
+    # grad norm, overflow counter, rank info
+    for field in ("ts", "wall_s", "tokens_per_sec", "loss", "loss_scale",
+                  "grad_norm", "overflows", "rank", "rank_info"):
+        assert field in last, field
+    assert isinstance(last["loss"], float)
+    assert isinstance(last["loss_scale"], float)
+    assert last["found_inf"] in (False, True)
+    assert last["overflows"] == 1  # exactly the step-2 found_inf
+    # sample_hbm_every=2: records 2 and 4 carry occupancy samples
+    assert "hbm" in steps[1] and "hbm" in steps[3]
+    assert "hbm" not in steps[0]
+    assert steps[3]["hbm"]["live_bytes"] >= 0
+
+
+def test_journal_scaler_state_and_shared_file(tmp_path):
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler.create(loss_scale="dynamic")
+    st = scaler_state(scaler)
+    assert st["loss_scale"] == 2.0 ** 16 and st["unskipped"] == 0
+
+    path = str(tmp_path / "shared.jsonl")
+    # two journal instances appending to one path (the bench subprocess
+    # pattern) interleave whole lines
+    j1, j2 = MetricsJournal(path), MetricsJournal(path)
+    j1.log({"src": 1})
+    j2.log({"src": 2})
+    j1.close()
+    j2.close()
+    assert sorted(r["src"] for r in MetricsJournal.read(path)) == [1, 2]
+
+
+def test_journal_never_raises_on_weird_values(tmp_path):
+    path = str(tmp_path / "w.jsonl")
+    with MetricsJournal(path) as j:
+        j.log({"arr": jnp.arange(3), "obj": object(), "nested": {"x": 1}})
+    (row,) = MetricsJournal.read(path)
+    assert row["arr"] == [0, 1, 2]  # small arrays list-ify
+    assert isinstance(row["obj"], str)  # default=str fallback
+
+
+# ---------------------------------------------------------------------------
+# hbm
+# ---------------------------------------------------------------------------
+
+
+def test_lane_padded_bytes():
+    # minor pads to 128 lanes: a (512, 1) f32 column costs 128x
+    assert lane_padded_bytes((512, 1), 4) == 512 * 128 * 4
+    # second-minor pads to the dtype sublane count (f32: 8, bf16: 16)
+    assert lane_padded_bytes((3, 128), 4) == 8 * 128 * 4
+    assert lane_padded_bytes((3, 128), 2) == 16 * 128 * 2
+    # aligned shapes pay no tax; leading dims multiply through
+    assert lane_padded_bytes((4, 8, 8, 128), 4) == 4 * 8 * 8 * 128 * 4
+    # rank-1 lays out as one (1, n) tile row
+    assert lane_padded_bytes((100,), 4) == 8 * 128 * 4
+
+
+def test_hbm_monitor_detects_retained_leak():
+    leak = HBMMonitor()
+    leak.sample("baseline")
+    retained = []
+    for i in range(4):
+        retained.append(jnp.ones((128, 128), jnp.float32) + i)
+        leak.sample(f"iter{i}")
+    assert leak.growth_bytes() >= 4 * 128 * 128 * 4
+    # visible growth is monotone across the retaining iterations
+    curve = [s["live_bytes"] for s in leak.samples]
+    assert all(b >= a for a, b in zip(curve, curve[1:]))
+
+    flat = HBMMonitor()
+    flat.sample("baseline")
+    for _ in range(4):
+        _ = float(jnp.sum(jnp.ones((128, 128), jnp.float32)))
+        flat.sample("iter")
+    assert abs(flat.growth_bytes()) < 128 * 128 * 4
+    del retained
+
+
+def test_hbm_monitor_journals_samples(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    with MetricsJournal(path) as j:
+        mon = HBMMonitor(journal=j, label="toy")
+        mon.sample("before")
+        mon.sample("after")
+    rows = [r for r in MetricsJournal.read(path) if r["kind"] == "hbm"]
+    assert [r["tag"] for r in rows] == ["before", "after"]
+    assert all(r["label"] == "toy" and "padded_bytes" in r for r in rows)
+
+
+def test_live_array_stats_counts_padded():
+    keep = jnp.ones((256, 1), jnp.float32)  # 128x lane-padding tax
+    stats = live_array_stats()
+    assert stats["count"] >= 1
+    assert stats["padded_bytes"] >= stats["live_bytes"]
+    del keep
+
+
+# ---------------------------------------------------------------------------
+# comms
+# ---------------------------------------------------------------------------
+
+
+def test_comm_accounting_by_axis_and_verb():
+    from apex_tpu.parallel import collectives
+
+    def fn(x):
+        y = collectives.psum(x, "i")
+        return collectives.all_gather(jnp.sum(y, -1), "i")
+
+    x = jnp.ones((2, 4, 8), jnp.float32)
+    with comm_accounting() as acct:
+        jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(x)
+    by_axis = acct.by_axis()
+    assert by_axis["i"]["calls"] == 2
+    assert by_axis["i"]["bytes"] == 4 * 8 * 4 + 4 * 4
+    by_verb = acct.by_verb()
+    assert by_verb["psum"]["bytes"] == 4 * 8 * 4
+    assert by_verb["all_gather"]["bytes"] == 4 * 4
+    assert acct.total_bytes() == 4 * 8 * 4 + 4 * 4
+    # outside the context nothing records
+    jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(x)
+    assert acct.by_axis()["i"]["calls"] == 2
+
+
+def test_comm_scopes_reach_trace_join_keys():
+    """The comm:<verb>[<axis>] scopes must be visible both to the jaxpr
+    scope walk (per_scope_costs) and to the compiled HLO op_name metadata
+    (the join key measured_scope_seconds uses) — that is what lets the
+    trace-join attribute measured comm seconds per mesh axis."""
+    from apex_tpu.parallel import collectives
+    from apex_tpu.pyprof import per_scope_costs
+
+    def fn(x):
+        return collectives.pmean(collectives.psum(x, "i"), "i")
+
+    x = jnp.ones((2, 8, 16), jnp.float32)
+    costs = per_scope_costs(jax.vmap(fn, axis_name="i"), x)
+    keys = " ".join(costs)
+    assert "comm:psum[i]" in keys and "comm:pmean[i]" in keys
+    hlo = jax.jit(jax.vmap(fn, axis_name="i")).lower(x).compile().as_text()
+    assert "comm:psum[i]" in hlo
+
+
+def test_comm_scopes_on_tp_mappings():
+    """The conjugate TP collectives in tensor_parallel/mappings.py carry
+    the same scopes (per-axis attribution of Megatron-style TP traffic)."""
+    from apex_tpu.pyprof import per_scope_costs
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        gather_from_tensor_model_parallel_region,
+        reduce_from_tensor_model_parallel_region,
+    )
+
+    def fn(x):
+        y = reduce_from_tensor_model_parallel_region(x, "model")
+        return gather_from_tensor_model_parallel_region(y, "model")
+
+    x = jnp.ones((2, 4, 8), jnp.float32)
+    with comm_accounting() as acct:
+        costs = per_scope_costs(jax.vmap(fn, axis_name="model"), x)
+    keys = " ".join(costs)
+    assert "comm:psum[model]" in keys and "comm:all_gather[model]" in keys
+    assert acct.by_axis()["model"]["calls"] == 2
+
+
+def test_sharded_train_path_accounts_per_axis():
+    """End-to-end: tracing the dryrun-style sharded grad step under
+    comm_accounting yields per-mesh-axis byte rows — the dp/tp attribution
+    the ISSUE asks the trace-join to carry."""
+    from apex_tpu.parallel import collectives, mesh as mesh_lib
+    from apex_tpu.parallel.distributed import allreduce_gradients
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map not available on this jax")
+    mesh = mesh_lib.make_virtual_mesh(4, tensor_model_parallel_size=2)
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        def grads_fn(g, loss):
+            g = allreduce_gradients(g, (mesh_lib.AXIS_DATA,))
+            return g, collectives.pmean(loss, (mesh_lib.AXIS_DATA,))
+
+        g = jnp.ones((8, 16), jnp.float32)
+        loss = jnp.asarray(1.0, jnp.float32)
+        fn = jax.shard_map(grads_fn, mesh=mesh, in_specs=(P("data"), P()),
+                           out_specs=(P("data"), P()), check_vma=False)
+        with comm_accounting() as acct:
+            jax.make_jaxpr(fn)(g, loss)
+        axes = acct.by_axis()
+        assert any("data" in k for k in axes), axes
+        assert acct.total_bytes() > 0
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+# -S skips sitecustomize (which can import an accelerator plugin and take
+# seconds) so stub children start fast enough to beat short deadlines
+PY = [sys.executable, "-S", "-c"]
+
+
+def test_watchdog_healthy_child_ok():
+    res = run_under_watchdog(PY + ["print('fine')"], deadline=30)
+    assert res.status == "ok" and res.returncode == 0
+    assert "fine" in res.stdout
+    assert res.record is None and res.reason == ""
+
+
+def test_watchdog_kills_hung_child_and_recovers_checkpoint():
+    code = (
+        "import json, os, time\n"
+        "with open(os.environ['APEX_TPU_CHECKPOINT_PATH'], 'w') as f:\n"
+        "    json.dump({'stage': 'resnet', 'value': 3.5}, f)\n"
+        "time.sleep(60)\n"
+    )
+    t0 = time.time()
+    res = run_under_watchdog(PY + [code], deadline=2, poll_s=0.1)
+    assert time.time() - t0 < 30  # killed at the deadline, not the sleep
+    assert res.status == "deadline"
+    assert "deadline" in res.reason
+    assert res.record == {"stage": "resnet", "value": 3.5}
+
+
+def test_watchdog_heartbeat_stall_beats_deadline():
+    """A child that beats once and then wedges is killed by the STALL
+    check (with the hard deadline still far away) and the last beaten
+    stage is named in the reason — 'wedged' vs 'slow but alive'."""
+    code = (
+        "import json, os, time\n"
+        "hb = os.environ['APEX_TPU_HEARTBEAT_PATH']\n"
+        "with open(hb, 'w') as f:\n"
+        "    json.dump({'ts': time.time(), 'stage': 'selftest'}, f)\n"
+        "time.sleep(60)\n"
+    )
+    t0 = time.time()
+    res = run_under_watchdog(PY + [code], deadline=300, stall_timeout=1.5,
+                             poll_s=0.1)
+    assert time.time() - t0 < 30
+    assert res.status == "stalled"
+    assert "selftest" in res.reason
+    assert res.heartbeat["stage"] == "selftest"
+
+
+def test_watchdog_stall_with_no_beat_uses_start_time():
+    res = run_under_watchdog(PY + ["import time; time.sleep(60)"],
+                             deadline=300, stall_timeout=1.0, poll_s=0.1)
+    assert res.status == "stalled"
+    assert "<no beat yet>" in res.reason
+
+
+def test_heartbeat_beat_and_read(tmp_path):
+    path = str(tmp_path / "hb.json")
+    hb = Heartbeat(path)
+    hb.beat("stage1", record={"v": 1})
+    got = Heartbeat.read(path)
+    assert got["stage"] == "stage1" and got["record"] == {"v": 1}
+    assert got["ts"] <= time.time()
+    assert Heartbeat.read(str(tmp_path / "missing.json")) is None
+
+
+def test_monitor_selftest_runs_green():
+    from apex_tpu.monitor import selftest
+
+    res = selftest.run()
+    assert res["all_ok"], res
+
+
+# ---------------------------------------------------------------------------
+# integration hooks: amp grad-norm, bench journal plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_amp_metrics_include_grad_norm_when_asked():
+    import optax
+
+    from apex_tpu import amp
+    from apex_tpu.ops.multi_tensor import tree_l2norm
+
+    params = {"w": jnp.ones((4, 4), jnp.float32) * 0.1}
+    grads = {"w": jnp.ones((4, 4), jnp.float32) * 2.0}
+    policy = amp.get_policy("O0")
+
+    plain = amp.MixedPrecisionOptimizer(optax.sgd(0.1), policy)
+    st = plain.init(params)
+    _, _, metrics = plain.apply_gradients(st, params, grads)
+    assert "grad_norm" not in metrics  # opt-in: default programs unchanged
+
+    inst = amp.MixedPrecisionOptimizer(optax.sgd(0.1), policy,
+                                       log_grad_norm=True)
+    st = inst.init(params)
+    _, _, metrics = inst.apply_gradients(st, params, grads)
+    np.testing.assert_allclose(float(metrics["grad_norm"]),
+                               float(tree_l2norm(grads)), rtol=1e-6)
+
+
+def test_bench_timed_windows_journal(tmp_path, monkeypatch):
+    """bench's shared window loop journals one record per window (wall
+    time, units/s, loss, the step metrics) when BENCH_JOURNAL is armed —
+    the CPU-side proof of the acceptance criterion's journal surface."""
+    import bench
+
+    path = str(tmp_path / "bench.jsonl")
+    monkeypatch.setenv("BENCH_JOURNAL", path)
+    monkeypatch.setattr(bench, "_JOURNAL", None)
+
+    loss = jnp.asarray(2.0, jnp.float32)
+    metrics = {"loss_scale": jnp.asarray(1024.0, jnp.float32),
+               "found_inf": jnp.asarray(False),
+               "grad_norm": jnp.asarray(0.25, jnp.float32)}
+    rates = bench._timed_windows(
+        lambda: None, lambda: loss, steps=2, windows=3,
+        per_window_units=2048, label="gpt_O2",
+        get_metrics=lambda: metrics)
+    bench._JOURNAL.close()
+    monkeypatch.setattr(bench, "_JOURNAL", None)
+    assert len(rates) == 3
+    rows = MetricsJournal.read(path)
+    assert [r["window"] for r in rows] == [0, 1, 2]
+    for r in rows:
+        assert r["label"] == "gpt_O2"
+        assert r["loss"] == 2.0
+        assert r["loss_scale"] == 1024.0
+        assert r["grad_norm"] == 0.25
+        assert r["tokens"] == 2048 and r["tokens_per_sec"] > 0
+        assert "hbm" in r  # occupancy sample rides every record
+
+
+def test_bench_journal_disabled_by_default(monkeypatch):
+    import bench
+
+    monkeypatch.delenv("BENCH_JOURNAL", raising=False)
+    monkeypatch.setattr(bench, "_JOURNAL", None)
+    assert bench._get_journal() is None
+    assert bench._state_metrics([1, 2, 3]) is None  # un-journaled state
+    m = {"loss_scale": 1.0}
+    assert bench._state_metrics([1, 2, 3, m])() is m
+
+
+def test_rank_info_str_reflects_mesh():
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    assert mesh_lib.get_rank_info_str() == ""
+    mesh_lib.make_virtual_mesh(8, tensor_model_parallel_size=2,
+                               pipeline_model_parallel_size=2)
+    try:
+        info = mesh_lib.get_rank_info_str()
+        assert "pp2" in info and "tp2" in info and "dp2" in info
+    finally:
+        mesh_lib.destroy_model_parallel()
+    assert mesh_lib.get_rank_info_str() == ""
