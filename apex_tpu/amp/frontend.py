@@ -73,6 +73,7 @@ class MixedPrecisionOptimizer:
         optimizer: Union[optax.GradientTransformation, ClassOptimizer],
         policy: _precision.Policy,
         log_grad_norm: bool = False,
+        log_group_norms: bool = False,
         **scaler_kwargs,
     ):
         self.inner = (
@@ -85,6 +86,12 @@ class MixedPrecisionOptimizer:
         #: step's matmuls, must be opt-in so uninstrumented programs stay
         #: byte-identical.
         self.log_grad_norm = bool(log_grad_norm)
+        #: when True, metrics also carry ``grad_norm_by_group`` — the L2
+        #: norm per top-level parameter group (monitor/diagnose.py's
+        #: overflow-forensics breakdown: a group whose norm is non-finite
+        #: names the first non-finite layer from the journal alone). Same
+        #: opt-in byte-identity contract as ``log_grad_norm``.
+        self.log_group_norms = bool(log_group_norms)
         self._scaler_kwargs = scaler_kwargs
 
     def init(self, model_params) -> MPOptState:
@@ -159,6 +166,10 @@ class MixedPrecisionOptimizer:
             # fp16_utils.FP16_Optimizer.step reports this unconditionally;
             # here it rides the metrics dict only when asked for
             metrics["grad_norm"] = tree_l2norm(grads32)
+        if self.log_group_norms:
+            from apex_tpu.monitor.diagnose import group_grad_norms
+
+            metrics["grad_norm_by_group"] = group_grad_norms(grads32)
         return new_model, MPOptState(new_inner, new_master, new_scaler), metrics
 
     # -- checkpointing (apex/amp/frontend.py:361-400) -----------------------

@@ -23,8 +23,22 @@ extracts those patterns into a reusable subsystem any training loop
   parent: a checkpoint-file + heartbeat-file protocol so any long-lived
   process survives the wedged-tunnel regime (device calls that never return)
   with its last per-stage record intact.
+- :mod:`mfu` — MFU/roofline reporting: joins pyprof cost totals (FLOPs +
+  bytes) with journal wall times against a per-platform peak-spec table
+  (env-overridable for the tunnel chip) into ``mfu`` / ``hbm_bw_util`` /
+  compute-vs-memory-bound fields per journal window.
+- :mod:`diagnose` — :class:`OverflowForensics` (on ``found_inf`` or a
+  loss spike, dump per-parameter-group grad norms, loss-scale history,
+  and the cumulative-overflow trajectory, so the first non-finite layer
+  is attributable from the journal alone) and :class:`RecompileTracker`
+  (jit cache misses + compile seconds per argument-shape signature —
+  the shape-churn detector).
+- :mod:`report` — ``python -m apex_tpu.monitor.report <run.jsonl>``:
+  throughput percentiles, stall gaps, loss spikes, HBM-growth trend,
+  per-rank straggler skew, comm rollups; ``... report compare A B``
+  exits non-zero on regression (the bench-trajectory machine gate).
 - :mod:`selftest` — ``python -m apex_tpu.monitor.selftest``: fast off-TPU
-  smoke of all four pieces, wired into ``__graft_entry__.dryrun_multichip``.
+  smoke of all pieces, wired into ``__graft_entry__.dryrun_multichip``.
 
 No reference-file citation: the reference (NVIDIA Apex) has no runtime
 telemetry layer; this subsystem generalizes bench.py's measurement
@@ -36,12 +50,27 @@ from apex_tpu.monitor.comms import (  # noqa: F401
     collective_scope,
     comm_accounting,
 )
+from apex_tpu.monitor.diagnose import (  # noqa: F401
+    OverflowForensics,
+    RecompileTracker,
+    group_grad_norms,
+)
 from apex_tpu.monitor.hbm import (  # noqa: F401
     HBMMonitor,
     lane_padded_bytes,
     live_array_stats,
 )
-from apex_tpu.monitor.journal import MetricsJournal, scaler_state  # noqa: F401
+from apex_tpu.monitor.journal import (  # noqa: F401
+    JournalRecords,
+    MetricsJournal,
+    scaler_state,
+)
+from apex_tpu.monitor.mfu import (  # noqa: F401
+    compiled_step_costs,
+    mfu_metrics,
+    peak_spec,
+    traced_step_costs,
+)
 from apex_tpu.monitor.watchdog import (  # noqa: F401
     Heartbeat,
     WatchdogResult,

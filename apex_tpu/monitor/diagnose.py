@@ -1,0 +1,300 @@
+"""Overflow/NaN forensics + recompile tracking: attributable diagnoses.
+
+PR 1 left the journal describing *that* a step overflowed (``found_inf``,
+cumulative ``overflows``) or slowed down; this module answers *why* from
+the journal alone:
+
+- :class:`OverflowForensics` — an opt-in host-side hook over
+  ``MixedPrecisionOptimizer``'s step metrics. On ``found_inf`` (or a
+  non-finite / spiking loss) it dumps ONE forensic record: the
+  per-parameter-group grad-norm breakdown (build the optimizer with
+  ``log_group_norms=True``; a group whose norm is non-finite names the
+  first non-finite layer), the recent loss-scale history, and the
+  cumulative-overflow trajectory — the evidence discipline EQuARX
+  (PAPERS.md, arxiv 2506.17615) applies to collective changes, applied
+  to loss-scale events. Pure host code after the step's loss fetch:
+  compiled programs are untouched.
+- :class:`RecompileTracker` — wraps a jitted callable and counts jit
+  cache misses and seconds spent in miss calls per argument-shape
+  signature (the shape-churn detector: a training loop that recompiles
+  every step because a batch dimension wobbles shows up as one
+  signature per step in the journal instead of a mystery slowdown).
+
+Both emit ``kind="forensics"`` / ``kind="recompile"`` journal rows that
+``python -m apex_tpu.monitor.report`` rolls up.
+
+No reference-file citation: NVIDIA Apex logs overflow skips to stdout
+(apex/amp/handle.py's "Gradient overflow" print) and has no recompile
+concept; both diagnoses here are TPU/XLA-native extensions.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+def group_grad_norms(grads) -> Dict[str, Any]:
+    """Per-parameter-group L2 norms of a grad pytree (traced-safe).
+
+    Top-level dict keys are the groups (``wte``/``layers``/... for the
+    GPT models); a non-dict tree reports one ``<params>`` row. The
+    per-group reduction reuses ``tree_l2norm`` so the breakdown matches
+    the global ``grad_norm`` metric's semantics exactly.
+    """
+    from apex_tpu.ops.multi_tensor import tree_l2norm
+
+    if isinstance(grads, dict) and grads:
+        return {str(k): tree_l2norm(v) for k, v in grads.items()}
+    return {"<params>": tree_l2norm(grads)}
+
+
+def _scalar(v) -> Optional[float]:
+    try:
+        return float(v)
+    except Exception:  # noqa: BLE001 - absent/odd metric values
+        return None
+
+
+def _isfinite(x: Optional[float]) -> bool:
+    return x is not None and x == x and abs(x) != float("inf")
+
+
+def median(values) -> Optional[float]:
+    """Plain median (None on empty) — shared by the forensics baseline
+    and ``monitor.report``'s offline rollups."""
+    s = sorted(values)
+    if not s:
+        return None
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def is_loss_spike(loss: float, baseline: Optional[float],
+                  spike_factor: float) -> bool:
+    """THE spike predicate — the one copy shared by the online
+    :class:`OverflowForensics` trigger and ``report.analyze``'s offline
+    spike list, so the two can never silently desynchronize."""
+    return (baseline is not None
+            and abs(loss) > spike_factor * max(abs(baseline), 1e-12))
+
+
+class OverflowForensics:
+    """Host-side overflow / loss-spike forensics over step metrics.
+
+    >>> forensics = OverflowForensics(journal)
+    >>> for step in range(steps):
+    ...     params, opt_state, loss, metrics = train_step(...)
+    ...     journal.step_end(step=step, loss=loss, metrics=metrics, ...)
+    ...     forensics.observe(step=step, loss=loss, metrics=metrics)
+
+    Call AFTER the journal's loss fetch (the device is drained; the
+    extra scalar fetches here are free). ``observe`` returns the
+    forensic record when this step triggered one, else None.
+    """
+
+    def __init__(
+        self,
+        journal=None,
+        *,
+        history: int = 64,
+        spike_window: int = 16,
+        spike_factor: float = 3.0,
+    ):
+        self.journal = journal
+        self.spike_factor = float(spike_factor)
+        #: (step, loss_scale) trail — the scale's recent trajectory
+        self.scale_history: deque = deque(maxlen=int(history))
+        #: recent FINITE, non-overflow losses — the spike baseline
+        self._losses: deque = deque(maxlen=int(spike_window))
+        #: steps that overflowed (cumulative trajectory)
+        self.overflow_steps: List[Any] = []
+        self.records: List[Dict[str, Any]] = []
+
+    # -- trigger logic ------------------------------------------------------
+    def _spike_baseline(self) -> Optional[float]:
+        if len(self._losses) < 4:
+            return None  # too little history to call anything a spike
+        return median(self._losses)
+
+    def observe(
+        self,
+        *,
+        step=None,
+        loss=None,
+        metrics: Optional[Dict[str, Any]] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Feed one step's host-side evidence; emit a record on trigger."""
+        metrics = metrics or {}
+        loss_val = _scalar(loss)
+        scale = _scalar(metrics.get("loss_scale"))
+        found_inf = bool(_scalar(metrics.get("found_inf")) or 0.0)
+        if scale is not None:
+            self.scale_history.append((step, scale))
+
+        trigger = None
+        baseline = self._spike_baseline()
+        if found_inf:
+            trigger = "overflow"
+        elif loss_val is not None and not _isfinite(loss_val):
+            trigger = "nonfinite_loss"
+        elif loss_val is not None and is_loss_spike(loss_val, baseline,
+                                                    self.spike_factor):
+            trigger = "loss_spike"
+
+        if found_inf:
+            self.overflow_steps.append(step)
+        elif _isfinite(loss_val):
+            self._losses.append(loss_val)
+
+        if trigger is None:
+            return None
+
+        rec: Dict[str, Any] = {
+            "kind": "forensics",
+            "trigger": trigger,
+            "step": step,
+            "loss": loss_val,
+            "loss_scale": scale,
+            "spike_baseline": baseline,
+            "overflows_total": len(self.overflow_steps),
+            "overflow_steps": self.overflow_steps[-16:],
+            "scale_history": [[s, v] for s, v in list(self.scale_history)[-16:]],
+        }
+        gn = _scalar(metrics.get("grad_norm"))
+        if gn is not None:
+            rec["grad_norm"] = gn
+        by_group = metrics.get("grad_norm_by_group")
+        if isinstance(by_group, dict):
+            norms = {k: _scalar(v) for k, v in by_group.items()}
+            rec["grad_norm_by_group"] = norms
+            # the attribution ask: WHICH group went non-finite first
+            rec["nonfinite_groups"] = sorted(
+                k for k, v in norms.items() if not _isfinite(v))
+        if extra:
+            rec.update(extra)
+        self.records.append(rec)
+        if self.journal is not None:
+            self.journal.log(dict(rec))
+        return rec
+
+    def summary(self) -> Dict[str, Any]:
+        by_trigger: Dict[str, int] = {}
+        for r in self.records:
+            by_trigger[r["trigger"]] = by_trigger.get(r["trigger"], 0) + 1
+        return {"records": len(self.records), "by_trigger": by_trigger,
+                "overflow_steps": list(self.overflow_steps)}
+
+
+# ---------------------------------------------------------------------------
+# recompile tracking
+# ---------------------------------------------------------------------------
+
+
+def _jit_cache_size(fn) -> Optional[int]:
+    """Best-effort jit cache size (None when the wrapped callable is not
+    a jitted function or the private accessor moved)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _arg_signature(args, kwargs) -> str:
+    """Stable shape/dtype signature of a call's arguments (the jit cache
+    key's observable part: avals, not values)."""
+    import jax
+
+    parts = []
+    for leaf in jax.tree.leaves((args, kwargs)):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None:
+            parts.append(f"{dtype}{list(shape)}")
+        else:
+            parts.append(type(leaf).__name__)
+    return ";".join(parts)
+
+
+class RecompileTracker:
+    """Count jit cache misses + seconds per function and arg signature.
+
+    >>> tracker = RecompileTracker(journal)
+    >>> train_step = tracker.wrap(jax.jit(step), name="train_step")
+    >>> ... call train_step as usual ...
+    >>> tracker.summary()
+    {'train_step': {'calls': 12, 'compiles': 2, 'compile_s': 31.2,
+                    'signatures': 2}}
+
+    A miss is detected from the jit cache growing across the call (the
+    authoritative signal); when the private cache probe is unavailable
+    the first call per shape/dtype signature counts instead.
+    ``compile_s`` is the wall time of miss calls — trace + compile +
+    first execution, the operator-facing cost of shape churn. Each miss
+    also lands a ``kind="recompile"`` journal row.
+    """
+
+    def __init__(self, journal=None):
+        self.journal = journal
+        self.stats: Dict[str, Dict[str, Any]] = {}
+
+    def wrap(self, fn: Callable, name: Optional[str] = None) -> Callable:
+        import functools
+
+        label = name or getattr(fn, "__name__", None) or repr(fn)
+        entry = self.stats.setdefault(
+            label, {"calls": 0, "compiles": 0, "compile_s": 0.0,
+                    "signatures": {}})
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            sig = _arg_signature(args, kwargs)
+            before = _jit_cache_size(fn)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            after = _jit_cache_size(fn)
+            if after is not None and before is not None:
+                missed = after > before
+            else:
+                missed = sig not in entry["signatures"]
+            entry["calls"] += 1
+            sig_row = entry["signatures"].setdefault(
+                sig, {"calls": 0, "compiles": 0, "compile_s": 0.0})
+            sig_row["calls"] += 1
+            if missed:
+                entry["compiles"] += 1
+                entry["compile_s"] += dt
+                sig_row["compiles"] += 1
+                sig_row["compile_s"] += dt
+                if self.journal is not None:
+                    self.journal.log({
+                        "kind": "recompile", "fn": label,
+                        "signature": sig[:200], "compile_s": round(dt, 4),
+                        "compiles_total": entry["compiles"],
+                        "cache_size": after,
+                    })
+            return out
+
+        wrapped.tracker_stats = entry
+        return wrapped
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-function rollup (signature count, not the full map)."""
+        return {
+            name: {"calls": e["calls"], "compiles": e["compiles"],
+                   "compile_s": round(e["compile_s"], 4),
+                   "signatures": len(e["signatures"])}
+            for name, e in self.stats.items()
+        }
+
+    def shape_churn(self, threshold: int = 3) -> Dict[str, int]:
+        """Functions compiled for more than ``threshold`` signatures —
+        the classic unpadded-batch/varying-seq defect."""
+        return {name: len(e["signatures"]) for name, e in self.stats.items()
+                if len(e["signatures"]) > threshold}

@@ -21,14 +21,20 @@ sample via ``jax.live_arrays()``, rank lookup) is host-side.
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
-from typing import Any, Dict, IO, Optional, Union
+from typing import Any, Dict, IO, List, Optional, Union
 
 
 def _to_host(v):
-    """Best-effort scalar conversion for record values; non-scalars pass
+    """Best-effort scalar conversion for record values (recursing into
+    dict/list containers — e.g. ``grad_norm_by_group``); non-scalars pass
     through repr-able as-is (json.dumps(default=str) catches the rest)."""
+    if isinstance(v, dict):
+        return {k: _to_host(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_to_host(x) for x in v]
     try:
         import numpy as np
 
@@ -41,6 +47,34 @@ def _to_host(v):
     except Exception:  # noqa: BLE001 - a journal write must never raise
         pass
     return v
+
+
+def _sanitize_nonfinite(v, path: str, bad: List[str]):
+    """Replace non-finite floats with None, recording their dotted key
+    paths — every journal line must be STRICT JSON (``json.dumps``'s
+    default ``allow_nan=True`` would emit bare ``NaN``/``Infinity``
+    tokens a strict parser rejects), and the ``nonfinite_keys`` field is
+    what the overflow forensics (monitor/diagnose.py) keys off."""
+    if isinstance(v, float) and not math.isfinite(v):
+        bad.append(path)
+        return None
+    if isinstance(v, dict):
+        return {k: _sanitize_nonfinite(x, f"{path}.{k}" if path else str(k), bad)
+                for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize_nonfinite(x, f"{path}[{i}]", bad)
+                for i, x in enumerate(v)]
+    return v
+
+
+class JournalRecords(list):
+    """``MetricsJournal.read``'s result: a plain list of record dicts
+    plus parse metadata — ``truncated`` (the final non-empty line failed
+    to parse: crash-/kill-time journals) and ``bad_lines`` (total
+    unparseable lines, e.g. a torn write mid-file)."""
+
+    truncated: bool = False
+    bad_lines: int = 0
 
 
 def scaler_state(scaler) -> Dict[str, Any]:
@@ -103,8 +137,34 @@ class MetricsJournal:
         self._t0: Optional[float] = None
         self._n = 0
         self.overflows = 0  # cumulative found_inf count (skip counter)
+        self._step_costs: Optional[Dict[str, Any]] = None
         if meta:
             self.log(dict(meta, kind="meta"))
+
+    # -- MFU arming (monitor/mfu.py) ----------------------------------------
+    def set_step_costs(
+        self,
+        *,
+        flops_per_token: float,
+        bytes_per_token: float = 0.0,
+        platform: Optional[str] = None,
+        method: str = "",
+    ) -> None:
+        """Arm per-record MFU/roofline fields: once set, every
+        :meth:`step_end` record that carries ``tokens`` and a wall time
+        also carries ``mfu``, ``hbm_bw_util``, ``bound``, ... joined
+        from these per-token cost totals and the platform peak spec
+        (``monitor.mfu.peak_spec`` — env-overridable through the
+        tunnel). Host-side only; the compiled step is untouched."""
+        from apex_tpu.monitor import mfu as _mfu  # lazy: journal stays light
+
+        self._step_costs = {
+            "flops_per_token": float(flops_per_token),
+            "bytes_per_token": float(bytes_per_token),
+            "spec": _mfu.peak_spec(platform),
+        }
+        if method:
+            self._step_costs["method"] = method
 
     # -- rank info (utils/log_util.py's RankInfoFilter, journal-side) -------
     @staticmethod
@@ -127,14 +187,20 @@ class MetricsJournal:
     # -- core sink ----------------------------------------------------------
     def log(self, record: Dict[str, Any]) -> Dict[str, Any]:
         """Write one record (any dict); fills ``v``/``kind``/``ts``/rank
-        fields, converts device scalars, never raises."""
+        fields, converts device scalars, never raises. Non-finite floats
+        are written as ``null`` with their paths in ``nonfinite_keys``,
+        so every line is STRICT JSON even when the loss goes NaN."""
         rec = {"v": self.SCHEMA_VERSION, "kind": record.get("kind", "step"),
                "ts": round(time.time(), 3)}
         rec.update(self._rank_fields())
         for k, v in record.items():
             rec[k] = _to_host(v)
+        bad: List[str] = []
+        rec = _sanitize_nonfinite(rec, "", bad)
+        if bad:
+            rec["nonfinite_keys"] = bad
         try:
-            self._f.write(json.dumps(rec, default=str) + "\n")
+            self._f.write(json.dumps(rec, default=str, allow_nan=False) + "\n")
             self._since_flush += 1
             if self._since_flush >= self.flush_every:
                 self._f.flush()
@@ -181,6 +247,22 @@ class MetricsJournal:
         if tokens is not None and wall_s:
             rec["tokens"] = int(tokens)
             rec["tokens_per_sec"] = round(tokens / wall_s, 1)
+            if self._step_costs is not None:
+                try:
+                    from apex_tpu.monitor import mfu as _mfu
+
+                    rec.update(_mfu.mfu_metrics(
+                        flops=self._step_costs["flops_per_token"] * tokens,
+                        bytes_accessed=(self._step_costs["bytes_per_token"]
+                                        * tokens),
+                        wall_s=wall_s,
+                        spec=self._step_costs["spec"]))
+                    if self._step_costs.get("method"):
+                        # jaxpr-armed bytes are a pre-fusion upper bound
+                        # (mfu.traced_step_costs); readers need to know
+                        rec["mfu_method"] = self._step_costs["method"]
+                except Exception:  # noqa: BLE001 - telemetry must not raise
+                    pass
         if metrics:
             for k, v in metrics.items():
                 rec[k] = _to_host(v)
@@ -217,12 +299,33 @@ class MetricsJournal:
         return False
 
     @staticmethod
-    def read(path: str):
-        """Parse a journal back into a list of dicts (schema round-trip)."""
-        out = []
+    def read(path: str) -> JournalRecords:
+        """Parse a journal back into a list of dicts (schema round-trip).
+
+        Tolerates a truncated/corrupt final line — a journal cut mid-write
+        by a crash or a watchdog kill must still parse (the whole point of
+        a crash-time journal). Good records come back as a
+        :class:`JournalRecords` list whose ``truncated`` flag marks a
+        broken final line and ``bad_lines`` counts every unparseable one.
+        """
+        out = JournalRecords()
+        last_bad = False  # streaming: never hold the raw file in memory
         with open(path) as f:
             for line in f:
                 line = line.strip()
-                if line:
-                    out.append(json.loads(line))
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    obj = None
+                if not isinstance(obj, dict):
+                    # unparseable OR a torn fragment that happens to be
+                    # valid scalar JSON ("42") — either way not a record
+                    out.bad_lines += 1
+                    last_bad = True
+                    continue
+                out.append(obj)
+                last_bad = False
+        out.truncated = last_bad
         return out

@@ -30,6 +30,7 @@ from apex_tpu.pyprof.prof import (  # noqa: F401
     per_scope_costs,
     primitive_counts,
     profile_fn,
+    program_costs,
     report,
     scope,
     trace,

@@ -541,6 +541,43 @@ def measured_report(
     return rows
 
 
+def program_costs(fn: Callable, *args, **kwargs) -> Dict[str, Any]:
+    """Compile-level cost totals for one call of ``fn``: ``{flops,
+    bytes_accessed, flops_xla_cost_model, flops_jaxpr,
+    flops_undercounted}``.
+
+    FLOPs are ``max(XLA cost model, jaxpr-level algorithmic count)``: the
+    cost model sees zero FLOPs inside Pallas custom-calls, so any program
+    whose compute lives in the flash kernels would be under-reported by it
+    alone (VERDICT r4 weak #3 — the 345M step is ~17 TFLOP by 6N·tokens
+    but 4.15 TFLOP by cost model); ``flops_undercounted`` flags a >2x
+    miss. ``bytes_accessed`` is the cost model's post-fusion HBM-traffic
+    estimate. These joint totals are what ``monitor.mfu`` divides by the
+    platform peak spec for the per-window MFU/roofline fields.
+    """
+    _, _, analysis = _compiled_with_analysis(fn, *args, **kwargs)
+    return _costs_from_analysis(analysis, fn, args, kwargs)
+
+
+def _costs_from_analysis(analysis, fn, args, kwargs) -> Dict[str, Any]:
+    """The one copy of the cost-join policy (max of cost model and jaxpr
+    count, >2x-miss flag) shared by :func:`program_costs` and
+    :func:`profile_fn`."""
+    flops_cost_model = float(analysis.get("flops", 0.0))
+    try:
+        flops_jaxpr = float(_walk_flops_only(
+            jax.make_jaxpr(fn)(*args, **kwargs).jaxpr))
+    except Exception:  # noqa: BLE001 - accounting must not kill the caller
+        flops_jaxpr = 0.0
+    return {
+        "flops": max(flops_cost_model, flops_jaxpr),
+        "bytes_accessed": float(analysis.get("bytes accessed", 0.0)),
+        "flops_xla_cost_model": flops_cost_model,
+        "flops_jaxpr": flops_jaxpr,
+        "flops_undercounted": bool(flops_cost_model < 0.5 * flops_jaxpr),
+    }
+
+
 def profile_fn(
     fn: Callable,
     *args,
@@ -550,21 +587,11 @@ def profile_fn(
     """Time a jitted ``fn`` and combine wall clock with FLOP accounting:
     returns ``{seconds_per_call, flops, achieved_flops_per_sec,
     bytes_accessed, achieved_bytes_per_sec}`` — the per-op efficiency table
-    of pyprof/prof/output.py, collapsed to the program level.
-
-    FLOPs are ``max(XLA cost model, jaxpr-level algorithmic count)``: the
-    cost model sees zero FLOPs inside Pallas custom-calls, so any program
-    whose compute lives in the flash kernels would be under-reported by it
-    alone (VERDICT r4 weak #3 — the 345M step is ~17 TFLOP by 6N·tokens
-    but 4.15 TFLOP by cost model). Both raw counts are returned, plus a
-    ``flops_undercounted`` flag when the cost model missed >2x."""
+    of pyprof/prof/output.py, collapsed to the program level. Cost totals
+    use the :func:`program_costs` join (cost model with the jaxpr floor),
+    sharing the already-compiled executable for the timing loop."""
     jitted, _, analysis = _compiled_with_analysis(fn, *args, **kwargs)
-    flops_cost_model = float(analysis.get("flops", 0.0))
-    try:
-        flops_jaxpr = float(_walk_flops_only(
-            jax.make_jaxpr(fn)(*args, **kwargs).jaxpr))
-    except Exception:  # noqa: BLE001 - accounting must not kill timing
-        flops_jaxpr = 0.0
+    costs = _costs_from_analysis(analysis, fn, args, kwargs)
     out = jitted(*args, **kwargs)  # warmup
     np.asarray(jax.tree.leaves(out)[0])
     t0 = time.perf_counter()
@@ -576,14 +603,14 @@ def profile_fn(
     # fetches would bill transfer bandwidth to compute).
     np.asarray(jax.tree.leaves(out)[0])
     dt = (time.perf_counter() - t0) / steps
-    flops = max(flops_cost_model, flops_jaxpr)
-    bytes_accessed = float(analysis.get("bytes accessed", 0.0))
+    flops = costs["flops"]
+    bytes_accessed = costs["bytes_accessed"]
     return {
         "seconds_per_call": dt,
         "flops": flops,
-        "flops_xla_cost_model": flops_cost_model,
-        "flops_jaxpr": flops_jaxpr,
-        "flops_undercounted": bool(flops_cost_model < 0.5 * flops_jaxpr),
+        "flops_xla_cost_model": costs["flops_xla_cost_model"],
+        "flops_jaxpr": costs["flops_jaxpr"],
+        "flops_undercounted": costs["flops_undercounted"],
         "achieved_flops_per_sec": flops / dt if dt > 0 else 0.0,
         "bytes_accessed": bytes_accessed,
         "achieved_bytes_per_sec": bytes_accessed / dt if dt > 0 else 0.0,

@@ -1,0 +1,71 @@
+"""jax version compatibility: one place for API renames we depend on.
+
+The framework is written against the modern surface (``jax.shard_map``
+with ``check_vma=``); older jax (< 0.5) ships the same functionality as
+``jax.experimental.shard_map.shard_map`` with the flag spelled
+``check_rep=``. :func:`ensure_shard_map` installs a thin adapter under
+``jax.shard_map`` when the top-level name is missing, translating the
+flag name — semantics are identical (the replication/varying-manual-axes
+check was renamed, not changed). Idempotent and a no-op on modern jax,
+so the adapter can be called from every entrypoint cheaply.
+
+Called explicitly from the runnable entrypoints (``__graft_entry__``,
+``bench.py``, ``examples/``, ``benchmarks/``) rather than from the
+package ``__init__``: the tier-1 suite's wall-clock budget is sized to
+the container's native jax surface, and silently widening what every
+test exercises from a package import is not this module's call to make.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def ensure_shard_map() -> bool:
+    """Install the ``jax.shard_map`` adapter if missing; returns True
+    when the modern API is available (natively or via the adapter)."""
+    import jax
+
+    try:
+        if getattr(jax, "shard_map", None) is not None:
+            return True
+    except Exception:  # noqa: BLE001 - deprecation getattr may raise
+        pass
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except Exception:  # noqa: BLE001 - neither spelling exists
+        return False
+
+    @functools.wraps(_legacy)
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:  # modern jax allows partial application
+            return lambda g: shard_map(g, **kwargs)
+        return _legacy(f, **kwargs)
+
+    jax.shard_map = shard_map
+    return True
+
+
+def ensure_lax_axis_size() -> bool:
+    """Install ``lax.axis_size`` when missing (jax < 0.4.38): the
+    historical spelling is ``lax.psum(1, axis)``, which returns a STATIC
+    python int inside any context that binds the axis — identical
+    semantics, tuple axes included (the psum over a tuple multiplies
+    through)."""
+    from jax import lax
+
+    if getattr(lax, "axis_size", None) is not None:
+        return True
+
+    def axis_size(axis_name):
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+    return True
+
+
+def ensure_jax_compat() -> bool:
+    """Install every adapter an entrypoint needs; True iff all landed."""
+    return bool(ensure_shard_map()) and bool(ensure_lax_axis_size())

@@ -59,9 +59,14 @@ Telemetry (r6): the watchdog/checkpoint machinery is the library's now
 beat per stage; BENCH_STALL arms the stale-heartbeat kill). Setting
 BENCH_JOURNAL=<path> makes every timed window, across all subprocess
 phases, append one JSON-lines record (wall time, tok/s, loss, loss-scale
-state, grad-norm, HBM occupancy sample) to that file via
+state, grad-norm, HBM occupancy sample — plus, for the GPT rungs,
+mfu/hbm_bw_util/bound joined from one extra trace against the peak-spec
+table, monitor/mfu.py; override the tunnel chip's measured ceiling via
+APEX_TPU_PEAK_FLOPS / APEX_TPU_PEAK_HBM_GBPS) to that file via
 apex_tpu.monitor.MetricsJournal; unset, the compiled programs are
-byte-identical to un-instrumented rounds.
+byte-identical to un-instrumented rounds. Journals analyze offline with
+`python -m apex_tpu.monitor.report <path>` (percentiles, stalls, spikes,
+HBM trend) and gate with `... report compare A B` (exit 1 on regression).
 """
 
 from __future__ import annotations
@@ -113,6 +118,49 @@ def _state_metrics(state):
     if len(state) > 3:
         return lambda: state[3]
     return None
+
+
+# per-token FLOP/byte totals per journal label ("gpt_O2"/"gpt_O0"), traced
+# once per prepared config when BENCH_JOURNAL is armed, so every timed
+# window's record carries mfu/hbm_bw_util/bound (monitor/mfu.py). Keyed by
+# label because the interleaved headline times two configs through one
+# journal. Host/trace-side only: the compiled programs are untouched.
+_WINDOW_COSTS = {}
+
+
+def _register_window_costs(label, step, params, opt_state, batch, seq):
+    try:
+        from apex_tpu.monitor import mfu as mfu_lib
+
+        tokens = jnp.zeros((batch, seq), jnp.int32)
+        costs = mfu_lib.traced_step_costs(step, params, opt_state,
+                                          tokens, tokens)
+        _WINDOW_COSTS[label] = {
+            "flops_per_token": costs["flops"] / (batch * seq),
+            "bytes_per_token": costs["bytes"] / (batch * seq),
+            "spec": mfu_lib.peak_spec(),
+            "method": costs["method"],
+        }
+    except Exception as e:  # noqa: BLE001 - telemetry must not kill bench
+        print(f"mfu costs unavailable for {label}: {e}", file=sys.stderr)
+
+
+def _window_mfu(label, per_window_units, dt):
+    costs = _WINDOW_COSTS.get(label)
+    if not costs:
+        return {}
+    try:
+        from apex_tpu.monitor import mfu as mfu_lib
+
+        fields = mfu_lib.mfu_metrics(
+            flops=costs["flops_per_token"] * per_window_units,
+            bytes_accessed=costs["bytes_per_token"] * per_window_units,
+            wall_s=dt, spec=costs["spec"])
+        if costs.get("method"):
+            fields["mfu_method"] = costs["method"]
+        return fields
+    except Exception:  # noqa: BLE001
+        return {}
 
 
 def _stats(rates):
@@ -167,7 +215,8 @@ def _timed_windows(advance, get_loss, *, steps, windows, per_window_units,
             journal.step_end(
                 loss=loss_val, wall_s=dt, tokens=per_window_units,
                 metrics=(get_metrics() if get_metrics else None),
-                label=label or "window", window=i, steps=steps)
+                label=label or "window", window=i, steps=steps,
+                **_window_mfu(label, per_window_units, dt))
     return rates
 
 
@@ -346,10 +395,14 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
     while True:
         for remat_policy, scan_chunk, unroll in _LADDERS[level]:
             try:
-                prep = _prepare(
-                    *build(level, impl, remat_policy, hidden, layers,
-                           unroll=unroll),
-                    batch, seq, steps, scan_chunk=scan_chunk)
+                step, params, opt_state = build(level, impl, remat_policy,
+                                                hidden, layers, unroll=unroll)
+                prep = _prepare(step, params, opt_state,
+                                batch, seq, steps, scan_chunk=scan_chunk)
+                if os.environ.get("BENCH_JOURNAL"):
+                    # one extra TRACE (no compile) arms per-window MFU
+                    _register_window_costs(f"gpt_{level}", step,
+                                           prep[4][0], prep[4][1], batch, seq)
                 return prep + (batch, {"remat": remat_policy or "full",
                                        "scan": scan_chunk,
                                        "unroll": unroll})
@@ -1335,6 +1388,15 @@ def _watchdog(cmd=None, env_extra=None):
 
 
 if __name__ == "__main__":
+    # jax<0.5 API renames (shard_map/axis_size): installed only when bench
+    # RUNS, not when tests import its helpers — the suite's behavior must
+    # not change from an import side effect
+    try:
+        from apex_tpu.utils.compat import ensure_jax_compat
+
+        ensure_jax_compat()
+    except Exception:  # noqa: BLE001 - bench must start even if apex_tpu broke
+        pass
     if "--selftest" in sys.argv:
         print(json.dumps({"selftest": selftest()}))
     elif ("--gpt-headline" in sys.argv or "--gpt-degraded" in sys.argv

@@ -120,7 +120,8 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
 
         with comm_accounting() as comm_acct:
             lowered = train_step.lower(params, opt_state, toks, tgts)
-        overlap = _overlap_evidence(lowered.compile())
+        compiled = lowered.compile()
+        overlap = _overlap_evidence(compiled)
 
         params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
         float(loss)  # compile + execute barrier
@@ -132,7 +133,7 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         conf = {"dp": dp, "tp": tp, "pp": pp, "layers": eff_layers}
         if cp > 1:
             conf["cp"] = cp
-        return {
+        row = {
             "config": conf,
             "avg_iteration_time_s": round(dt, 4),
             "tokens_per_sec": round(batch * seq / dt, 1),
@@ -142,6 +143,29 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             # scanned sites count once; see monitor/comms.py)
             "comm_bytes_by_axis": comm_acct.by_axis(),
         }
+        try:
+            # MFU/roofline verdict per config (monitor/mfu.py): cost-model
+            # FLOPs+bytes for the compiled step over the measured iteration
+            # time, against the platform peak spec. On the CPU virtual mesh
+            # this carries source="table:cpu" — a labelled emulation number
+            # under the same reading-guide caveat as tokens_per_sec.
+            from apex_tpu.monitor import mfu as mfu_lib
+
+            # the jaxpr floor guards the Pallas undercount (the cost
+            # model sees zero FLOPs inside the flash-attention
+            # custom-calls — 4.15 vs ~17 TFLOP on the 345M step,
+            # PERF_NOTES); one extra trace, no compile
+            jaxpr_flops = mfu_lib.traced_step_costs(
+                train_step, params, opt_state, toks, tgts)["flops"]
+            costs = mfu_lib.compiled_step_costs(compiled,
+                                                jaxpr_flops=jaxpr_flops)
+            row["mfu"] = mfu_lib.mfu_metrics(
+                flops=costs["flops"], bytes_accessed=costs["bytes"],
+                wall_s=dt, tokens=batch * seq)
+            row["mfu"]["flops_method"] = costs["method"]
+        except Exception as e:  # noqa: BLE001 - mfu is best-effort evidence
+            row["mfu"] = {"error": str(e)[:120]}
+        return row
     finally:
         mesh_lib.destroy_model_parallel()
 
@@ -185,6 +209,13 @@ _TABLE_NOTES = {
         "number - NOT a scaling-efficiency measurement; BASELINE target "
         "2's >=90% DDP efficiency cannot be measured on this backend at "
         "all."),
+    "mfu": (
+        "per-config mfu/hbm_bw_util/bound join the compiled step's XLA "
+        "cost-model FLOPs+bytes with the measured iteration time against "
+        "the peak-spec table (apex_tpu/monitor/mfu.py; calibrate via "
+        "APEX_TPU_PEAK_FLOPS / APEX_TPU_PEAK_HBM_GBPS). peak_source "
+        "'table:cpu' marks a virtual-mesh emulation number, not a TPU "
+        "utilization claim."),
     "overlap": (
         "overlap.async_pairs reflects the CPU backend's synchronous "
         "collective lowering, not TPU behavior. TPU-targeted async "
@@ -269,6 +300,12 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
 
 
 def main():
+    # jax<0.5 API renames (shard_map/axis_size): installed only when the
+    # harness RUNS as a program — tests importing run_config/run_grid see
+    # the container's native jax surface unchanged
+    from apex_tpu.utils.compat import ensure_jax_compat
+
+    ensure_jax_compat()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--layers", type=str, default="4",

@@ -32,6 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from apex_tpu.utils.compat import ensure_jax_compat
+
+ensure_jax_compat()  # jax<0.5: shard_map/axis_size API renames
+
 from apex_tpu import amp, checkpoint
 from apex_tpu.models import GPTConfig, GPTModel
 from apex_tpu.optimizers import FusedAdam
@@ -100,9 +104,12 @@ def main():
     )
     model = GPTModel(cfg)
     policy = amp.get_policy(args.opt_level)
-    # journaled runs also want the global grad-norm in the step metrics
+    # journaled runs also want the global grad-norm AND the per-group
+    # breakdown (overflow forensics, monitor/diagnose.py) in the metrics;
+    # un-journaled programs stay byte-identical (both flags default off)
     mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=args.lr), policy,
-                                         log_grad_norm=bool(args.journal))
+                                         log_grad_norm=bool(args.journal),
+                                         log_group_norms=bool(args.journal))
 
     full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
     all_specs = model.specs()
@@ -176,15 +183,43 @@ def main():
         start = step
         print(f"resumed from step {step}")
 
-    journal = None
+    journal = forensics = None
     if args.journal:
-        from apex_tpu.monitor import MetricsJournal
+        from apex_tpu.monitor import (
+            MetricsJournal,
+            OverflowForensics,
+            RecompileTracker,
+        )
+        from apex_tpu.monitor import mfu as mfu_lib
 
         journal = MetricsJournal(
             args.journal, sample_hbm_every=10,
             meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
                   "dp": dp, "hidden": args.hidden, "layers": args.layers,
                   "seq": args.seq, "batch": batch})
+        # diagnostics engine (monitor/diagnose.py): overflow/loss-spike
+        # forensics keyed off the per-group grad norms above, plus the
+        # shape-churn detector around the jitted step — both host-side
+        forensics = OverflowForensics(journal)
+        try:
+            # one extra TRACE (no compile) arms per-step MFU/roofline
+            # fields: jaxpr FLOPs/bytes per token joined against the
+            # peak-spec table (env-calibratable, monitor/mfu.py). Traced
+            # BEFORE the recompile wrapper so arming never journals as a
+            # spurious compile, and on zeros so no real batch from
+            # --data is consumed just for tracing (bench.py's
+            # _register_window_costs idiom)
+            z = shard(jnp.zeros((batch, args.seq), jnp.int32))
+            costs = mfu_lib.traced_step_costs(
+                train_step, params, opt_state, z, z)
+            journal.set_step_costs(
+                flops_per_token=costs["flops"] / (batch * args.seq),
+                bytes_per_token=costs["bytes"] / (batch * args.seq),
+                method=costs["method"])
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"mfu arming failed (journal continues without): {e}")
+        train_step = RecompileTracker(journal).wrap(train_step,
+                                                    name="train_step")
 
     t0 = time.perf_counter()
     for i in range(start, start + args.steps):
@@ -198,6 +233,7 @@ def main():
             # (tunnel discipline); metrics/scaler fetches ride after it
             journal.step_end(step=i, loss=loss, tokens=batch * args.seq,
                              metrics=metrics, scaler=opt_state.scaler)
+            forensics.observe(step=i, loss=loss, metrics=metrics)
         if i == start:
             float(loss)  # exclude compile
             t0 = time.perf_counter()

@@ -1,0 +1,257 @@
+"""Tests for apex_tpu.monitor.diagnose — overflow/NaN forensics (per-group
+grad-norm attribution through the real MixedPrecisionOptimizer path),
+loss-spike triggers, the recompile/shape-churn tracker, and the static
+guarantee that every collective verb carries a ``comm:`` scope."""
+
+import ast
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.monitor import MetricsJournal, OverflowForensics, RecompileTracker
+from apex_tpu.monitor.diagnose import group_grad_norms
+
+
+# ---------------------------------------------------------------------------
+# per-group grad norms + the amp opt-in hook
+# ---------------------------------------------------------------------------
+
+
+def test_group_grad_norms_per_top_level_key():
+    grads = {"wte": {"w": jnp.full((2, 2), 3.0)},
+             "head": jnp.asarray([4.0, 0.0])}
+    norms = group_grad_norms(grads)
+    np.testing.assert_allclose(float(norms["wte"]), 6.0, rtol=1e-6)
+    np.testing.assert_allclose(float(norms["head"]), 4.0, rtol=1e-6)
+    # non-dict trees report a single row
+    flat = group_grad_norms(jnp.asarray([3.0, 4.0]))
+    np.testing.assert_allclose(float(flat["<params>"]), 5.0, rtol=1e-6)
+
+
+def test_amp_group_norms_opt_in_only():
+    """Default metrics surface is unchanged (the byte-identity contract:
+    uninstrumented programs carry no extra outputs); the opt-in flag adds
+    the per-group breakdown matching tree_l2norm per group."""
+    import optax
+
+    from apex_tpu import amp
+    from apex_tpu.ops.multi_tensor import tree_l2norm
+
+    params = {"a": jnp.ones((2, 2)), "b": jnp.ones((3,))}
+    grads = {"a": jnp.full((2, 2), 2.0), "b": jnp.full((3,), 0.5)}
+    policy = amp.get_policy("O0")
+
+    plain = amp.MixedPrecisionOptimizer(optax.sgd(0.1), policy)
+    st = plain.init(params)
+    _, _, metrics = plain.apply_gradients(st, params, grads)
+    assert set(metrics) == {"found_inf", "loss_scale"}
+
+    inst = amp.MixedPrecisionOptimizer(optax.sgd(0.1), policy,
+                                       log_group_norms=True)
+    st = inst.init(params)
+    _, _, metrics = inst.apply_gradients(st, params, grads)
+    by_group = metrics["grad_norm_by_group"]
+    for key in ("a", "b"):
+        np.testing.assert_allclose(float(by_group[key]),
+                                   float(tree_l2norm(grads[key])), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# overflow forensics
+# ---------------------------------------------------------------------------
+
+
+def test_forensics_on_forced_overflow_through_amp(tmp_path):
+    """The acceptance path: force an overflow through the REAL
+    MixedPrecisionOptimizer, observe the metrics, and get a forensic
+    record that names the non-finite parameter group — from the journal
+    alone."""
+    import optax
+
+    from apex_tpu import amp
+
+    params = {"wte": jnp.ones((4, 4), jnp.float32),
+              "layers": jnp.ones((8,), jnp.float32)}
+    policy = amp.get_policy("O0")
+    mp_opt = amp.MixedPrecisionOptimizer(optax.sgd(0.1), policy,
+                                         log_grad_norm=True,
+                                         log_group_norms=True)
+    state = mp_opt.init(params)
+
+    path = str(tmp_path / "f.jsonl")
+    with MetricsJournal(path) as journal:
+        forensics = OverflowForensics(journal)
+        # a few healthy steps establish the spike baseline
+        good = {"wte": jnp.full((4, 4), 0.1), "layers": jnp.full((8,), 0.1)}
+        for step in range(5):
+            new_params, state, metrics = mp_opt.apply_gradients(
+                state, params, good)
+            journal.step_end(step=step, loss=jnp.asarray(2.0), tokens=64,
+                             metrics=metrics)
+            assert forensics.observe(step=step, loss=2.0,
+                                     metrics=metrics) is None
+        # the forced overflow: one group's grads go inf
+        bad = {"wte": jnp.full((4, 4), 0.1),
+               "layers": jnp.full((8,), jnp.inf)}
+        new_params, state, metrics = mp_opt.apply_gradients(state, params, bad)
+        assert bool(metrics["found_inf"])
+        # overflow step skipped: params unchanged
+        np.testing.assert_array_equal(np.asarray(new_params["wte"]),
+                                      np.asarray(params["wte"]))
+        journal.step_end(step=5, loss=jnp.asarray(2.0), tokens=64,
+                         metrics=metrics)
+        rec = forensics.observe(step=5, loss=2.0, metrics=metrics)
+
+    assert rec is not None and rec["trigger"] == "overflow"
+    assert rec["nonfinite_groups"] == ["layers"]  # the attribution
+    assert rec["overflows_total"] == 1 and rec["overflow_steps"] == [5]
+    assert np.isfinite(rec["grad_norm_by_group"]["wte"])
+
+    rows = MetricsJournal.read(path)
+    f_rows = [r for r in rows if r["kind"] == "forensics"]
+    assert len(f_rows) == 1
+    # journal-side sanitization: the inf norm is null, its path recorded
+    assert f_rows[0]["grad_norm_by_group"]["layers"] is None
+    assert any("grad_norm_by_group.layers" in k
+               for k in f_rows[0]["nonfinite_keys"])
+    # the step record itself also carries the breakdown (journal-alone
+    # attribution: no separate sidecar needed)
+    step5 = [r for r in rows if r.get("step") == 5 and r["kind"] == "step"]
+    assert step5 and step5[0]["grad_norm_by_group"]["layers"] is None
+
+
+def test_forensics_loss_spike_and_nonfinite_triggers():
+    forensics = OverflowForensics(spike_factor=3.0)
+    for step in range(6):
+        assert forensics.observe(step=step, loss=1.0,
+                                 metrics={"found_inf": False}) is None
+    spike = forensics.observe(step=6, loss=10.0,
+                              metrics={"found_inf": False})
+    assert spike is not None and spike["trigger"] == "loss_spike"
+    assert spike["spike_baseline"] == 1.0
+    # the spike did NOT poison the baseline: a normal loss is quiet again
+    assert forensics.observe(step=7, loss=1.1,
+                             metrics={"found_inf": False}) is None
+    nan = forensics.observe(step=8, loss=float("nan"),
+                            metrics={"found_inf": False})
+    assert nan is not None and nan["trigger"] == "nonfinite_loss"
+    assert forensics.summary()["by_trigger"] == {"loss_spike": 1,
+                                                 "nonfinite_loss": 1}
+
+
+def test_forensics_scale_history_trajectory():
+    forensics = OverflowForensics(history=8)
+    scale = 2.0 ** 16
+    for step in range(4):
+        forensics.observe(step=step, loss=1.0,
+                          metrics={"found_inf": False, "loss_scale": scale})
+    rec = forensics.observe(step=4, loss=1.0,
+                            metrics={"found_inf": True,
+                                     "loss_scale": scale / 2})
+    assert rec["trigger"] == "overflow"
+    assert rec["scale_history"][-1] == [4, scale / 2]
+    assert rec["scale_history"][0] == [0, scale]
+
+
+# ---------------------------------------------------------------------------
+# recompile tracker (shape-churn detector)
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_tracker_counts_misses(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    with MetricsJournal(path) as journal:
+        tracker = RecompileTracker(journal)
+        fn = tracker.wrap(jax.jit(lambda x: x * 2 + 1), name="poly")
+        fn(jnp.ones((4,)))
+        fn(jnp.zeros((4,)))          # same shape: cache hit
+        fn(jnp.ones((8,)))           # fresh shape: miss
+        fn(jnp.ones((8,), jnp.int32))  # fresh dtype: miss
+        summary = tracker.summary()["poly"]
+    assert summary["calls"] == 4
+    assert summary["compiles"] == 3
+    assert summary["signatures"] == 3
+    assert summary["compile_s"] > 0
+    rows = [r for r in MetricsJournal.read(path) if r["kind"] == "recompile"]
+    assert len(rows) == 3
+    assert all(r["fn"] == "poly" and r["compile_s"] >= 0 for r in rows)
+    assert rows[-1]["compiles_total"] == 3
+
+
+def test_recompile_tracker_shape_churn_flag():
+    tracker = RecompileTracker()
+    fn = tracker.wrap(jax.jit(lambda x: x + 1), name="churny")
+    for n in range(1, 6):
+        fn(jnp.ones((n,)))
+    assert tracker.shape_churn(threshold=3) == {"churny": 5}
+    assert tracker.shape_churn(threshold=8) == {}
+
+
+def test_recompile_tracker_preserves_results():
+    tracker = RecompileTracker()
+    fn = tracker.wrap(jax.jit(lambda x: x * 3))
+    np.testing.assert_array_equal(np.asarray(fn(jnp.asarray([2.0]))), [6.0])
+
+
+# ---------------------------------------------------------------------------
+# static check: every collective verb carries a comm: scope
+# ---------------------------------------------------------------------------
+
+# the data-moving named-axis collectives (axis_index/axis_size are
+# rank/topology queries, not communication)
+_COMM_PRIMS = {"psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+               "ppermute", "all_to_all", "pshuffle", "all_gather_invariant"}
+
+
+def _scope_violations(path):
+    """Functions that CALL a lax collective without ALSO calling the
+    ``comm:`` scope helper (``_comm`` / ``collective_scope``) somewhere in
+    their body — the accounting contract every verb must carry."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+
+    def calls_in(node, pred):
+        return [n for n in ast.walk(node)
+                if isinstance(n, ast.Call) and pred(n.func)]
+
+    def is_lax_collective(func):
+        return (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "lax" and func.attr in _COMM_PRIMS)
+
+    def is_scope_helper(func):
+        name = getattr(func, "id", None) or getattr(func, "attr", None)
+        return name in ("_comm", "collective_scope")
+
+    violations, verbs = [], 0
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        collectives = calls_in(node, is_lax_collective)
+        if not collectives:
+            continue
+        verbs += 1
+        if not calls_in(node, is_scope_helper):
+            violations.append(
+                (node.name, sorted({c.func.attr for c in collectives})))
+    return violations, verbs
+
+
+@pytest.mark.parametrize("relpath,min_verbs", [
+    (os.path.join("apex_tpu", "parallel", "collectives.py"), 7),
+    (os.path.join("apex_tpu", "transformer", "tensor_parallel",
+                  "mappings.py"), 4),
+])
+def test_every_collective_verb_carries_comm_scope(relpath, min_verbs):
+    """A future verb added to collectives.py/mappings.py without the
+    ``comm:`` scope would silently drop per-axis accounting; this static
+    check makes that a test failure instead."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations, verbs = _scope_violations(os.path.join(root, relpath))
+    assert not violations, (
+        f"collective verbs without a comm: scope in {relpath}: {violations}")
+    # the check must actually be scanning verbs, not vacuously passing
+    assert verbs >= min_verbs, (relpath, verbs)

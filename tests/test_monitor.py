@@ -92,6 +92,62 @@ def test_journal_never_raises_on_weird_values(tmp_path):
     assert isinstance(row["obj"], str)  # default=str fallback
 
 
+def test_journal_sanitizes_nonfinite_to_strict_json(tmp_path):
+    """A NaN loss (or inf metric) must not poison the journal with bare
+    ``NaN`` tokens: every line stays STRICT JSON — non-finite floats
+    become null and their paths land in ``nonfinite_keys`` (the field
+    the overflow forensics keys off)."""
+    path = str(tmp_path / "nan.jsonl")
+    with MetricsJournal(path) as j:
+        j.step_end(step=0, loss=jnp.asarray(float("nan")), tokens=64,
+                   wall_s=0.1,
+                   metrics={"grad_norm": jnp.asarray(float("inf")),
+                            "nested": {"deep": [1.0, float("nan")]}})
+        j.step_end(step=1, loss=jnp.asarray(1.5), tokens=64, wall_s=0.1)
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    for line in lines:
+        # parse_constant raises on non-strict NaN/Infinity tokens
+        json.loads(line, parse_constant=lambda t: (_ for _ in ()).throw(
+            ValueError(f"non-strict token {t}")))
+    rows = MetricsJournal.read(path)
+    bad, good = rows[0], rows[1]
+    assert bad["loss"] is None and bad["grad_norm"] is None
+    assert bad["nested"]["deep"] == [1.0, None]
+    assert sorted(bad["nonfinite_keys"]) == [
+        "grad_norm", "loss", "nested.deep[1]"]
+    # finite records carry no sanitization residue
+    assert good["loss"] == 1.5 and "nonfinite_keys" not in good
+
+
+def test_journal_read_tolerates_truncated_final_line(tmp_path):
+    """Crash-/watchdog-kill-time journals end mid-line; the good prefix
+    must still parse, with the damage flagged."""
+    path = str(tmp_path / "torn.jsonl")
+    with MetricsJournal(path) as j:
+        for step in range(3):
+            j.step_end(step=step, loss=jnp.asarray(1.0), tokens=8,
+                       wall_s=0.1)
+    with open(path, "a") as f:
+        f.write('{"v": 1, "kind": "step", "step": 3, "wal')  # torn write
+    rows = MetricsJournal.read(path)
+    assert [r["step"] for r in rows] == [0, 1, 2]
+    assert rows.truncated is True and rows.bad_lines == 1
+
+    # a corrupt MID-file line is dropped and counted, but does not mark
+    # the journal truncated (the tail is intact); a torn fragment that
+    # happens to parse as scalar JSON ("42") is equally not a record
+    mid = str(tmp_path / "mid.jsonl")
+    with open(mid, "w") as f:
+        f.write('{"kind": "step", "step": 0}\n')
+        f.write("garbage not json\n")
+        f.write("42\n")
+        f.write('{"kind": "step", "step": 1}\n')
+    rows = MetricsJournal.read(mid)
+    assert [r["step"] for r in rows] == [0, 1]
+    assert rows.truncated is False and rows.bad_lines == 2
+
+
 # ---------------------------------------------------------------------------
 # hbm
 # ---------------------------------------------------------------------------
@@ -149,6 +205,37 @@ def test_live_array_stats_counts_padded():
     del keep
 
 
+def test_hbm_monitor_empty_baseline(monkeypatch):
+    """A monitor started before ANY array exists (fresh process, no
+    backend traffic yet: ``jax.live_arrays()`` empty) must report growth
+    against the zero baseline and a well-defined peak — and the
+    degenerate no-/one-sample cases must not divide or index into
+    nothing."""
+    from apex_tpu.monitor import hbm as hbm_mod
+
+    feed = iter([
+        {"live_bytes": 0, "padded_bytes": 0, "count": 0, "largest_bytes": 0},
+        {"live_bytes": 4096, "padded_bytes": 8192, "count": 1,
+         "largest_bytes": 4096},
+        {"live_bytes": 1024, "padded_bytes": 2048, "count": 1,
+         "largest_bytes": 1024},
+    ])
+    monkeypatch.setattr(hbm_mod, "live_array_stats", lambda: dict(next(feed)))
+
+    mon = hbm_mod.HBMMonitor()
+    assert mon.growth_bytes() == 0 and mon.peak_bytes() == 0  # no samples
+    assert mon.baseline is None
+    mon.sample("empty-baseline")
+    assert mon.growth_bytes() == 0  # one sample: nothing to diff yet
+    assert mon.peak_bytes() == 0
+    mon.sample("allocated")
+    assert mon.growth_bytes() == 4096  # growth FROM the empty baseline
+    assert mon.peak_bytes() == 4096
+    mon.sample("freed")
+    assert mon.growth_bytes() == 1024  # last-minus-baseline, not peak
+    assert mon.peak_bytes() == 4096   # peak remembers the high-water mark
+
+
 # ---------------------------------------------------------------------------
 # comms
 # ---------------------------------------------------------------------------
@@ -174,6 +261,38 @@ def test_comm_accounting_by_axis_and_verb():
     # outside the context nothing records
     jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(x)
     assert acct.by_axis()["i"]["calls"] == 2
+
+
+def test_comm_account_reentrancy():
+    """Nested accounting contexts both observe every call, nested
+    ``collective_scope``s on the SAME axis each tally their own call
+    site, and an inner context's exit never unhooks the outer one."""
+    from apex_tpu.monitor.comms import collective_scope
+
+    x = jnp.ones((4, 8), jnp.float32)
+    nbytes = 4 * 8 * 4
+    with comm_accounting() as outer:
+        with collective_scope("psum", "data", x):
+            # nested scope on the same axis (the broadcast-inside-gather
+            # shape): a distinct call site, tallied separately
+            with collective_scope("all_gather", "data", x):
+                pass
+        with comm_accounting() as inner:
+            with collective_scope("pmean", "data", x):
+                pass
+        # inner closed; outer must still be live
+        with collective_scope("psum", "model", x):
+            pass
+    assert inner.by_verb() == {"pmean": {"bytes": nbytes, "calls": 1}}
+    by_axis = outer.by_axis()
+    assert by_axis["data"] == {"bytes": 3 * nbytes, "calls": 3}
+    assert by_axis["model"] == {"bytes": nbytes, "calls": 1}
+    assert outer.by_verb()["psum"]["calls"] == 2
+    # after both contexts exit, scopes no longer tally anywhere
+    with collective_scope("psum", "data", x):
+        pass
+    assert outer.total_bytes() == 4 * nbytes
+    assert inner.total_bytes() == nbytes
 
 
 def test_comm_scopes_reach_trace_join_keys():
@@ -392,6 +511,48 @@ def test_bench_journal_disabled_by_default(monkeypatch):
     assert bench._state_metrics([1, 2, 3]) is None  # un-journaled state
     m = {"loss_scale": 1.0}
     assert bench._state_metrics([1, 2, 3, m])() is m
+
+
+def test_bench_windows_carry_mfu_when_costs_registered(tmp_path, monkeypatch):
+    """The GPT-rung path: prepare registers per-token costs once (one
+    trace), then every timed window's journal record carries
+    mfu/hbm_bw_util/bound — and unregistered labels (resnet/bert rungs)
+    stay mfu-free."""
+    import bench
+
+    path = str(tmp_path / "mfu.jsonl")
+    monkeypatch.setenv("BENCH_JOURNAL", path)
+    monkeypatch.setattr(bench, "_JOURNAL", None)
+    monkeypatch.setattr(bench, "_WINDOW_COSTS", {})
+    monkeypatch.setenv("APEX_TPU_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("APEX_TPU_PEAK_HBM_GBPS", "100")
+
+    batch, seq, hidden = 2, 8, 4
+
+    def step(params, opt_state, tokens, targets):
+        # toy "train step" with a real matmul so traced costs are nonzero
+        h = jnp.einsum("bs,sh->bh", tokens.astype(jnp.float32), params)
+        return params - 0.0 * h.sum(), opt_state, h.sum(), {}
+
+    params = jnp.ones((seq, hidden), jnp.float32)
+    bench._register_window_costs("gpt_O2", step, params, (), batch, seq)
+    assert "gpt_O2" in bench._WINDOW_COSTS
+    assert bench._WINDOW_COSTS["gpt_O2"]["flops_per_token"] > 0
+    assert bench._WINDOW_COSTS["gpt_O2"]["spec"]["source"] == "env"
+
+    loss = jnp.asarray(1.0, jnp.float32)
+    bench._timed_windows(lambda: None, lambda: loss, steps=1, windows=2,
+                         per_window_units=batch * seq, label="gpt_O2")
+    bench._timed_windows(lambda: None, lambda: loss, steps=1, windows=1,
+                         per_window_units=64, label="resnet50")
+    bench._JOURNAL.close()
+    monkeypatch.setattr(bench, "_JOURNAL", None)
+    rows = MetricsJournal.read(path)
+    gpt = [r for r in rows if r.get("label") == "gpt_O2"]
+    other = [r for r in rows if r.get("label") == "resnet50"]
+    assert len(gpt) == 2 and all("mfu" in r and "bound" in r for r in gpt)
+    assert all(r["peak_source"] == "env" for r in gpt)
+    assert other and all("mfu" not in r for r in other)
 
 
 def test_rank_info_str_reflects_mesh():
