@@ -1,4 +1,4 @@
-"""apex_tpu.contrib — TPU-native counterparts of apex/contrib.
+"""apex_tpu.contrib — TPU-native counterparts (reference: apex/contrib/).
 
 Implemented here: multihead_attn (fused self/enc-dec MHA ± norm-add),
 fmha (packed cu_seqlens varlen attention over the flash kernel),

@@ -34,6 +34,10 @@ class PrefetchIterator:
                 for item in it:
                     self._q.put(item)
             except BaseException as e:  # surfaced on next()
+                # deliberate retention: the worker failure must re-raise on
+                # next(); host-side iterator state, freed with the loader,
+                # no device frames in the traceback
+                # lint: disable=exception-retention -- re-raised on next(); host-side, no device frames
                 self._err = e
             finally:
                 self._q.put(self._SENTINEL)

@@ -1,0 +1,42 @@
+"""apex_tpu.lint -- project-invariant linter + jaxpr-level hazard analyzers.
+
+The repo's hardest-won correctness and performance invariants used to be
+enforced by hand: CLAUDE.md prose (never differentiate a bare
+``lax.psum``/``pmean`` of the loss; never time off a bare
+``block_until_ready``; the T(8,128) lane-padding tax) plus one ad-hoc AST
+walker inside tests/test_diagnose.py. veScale-style SPMD stacks (PAPERS.md,
+arxiv 2509.07003) and the cross-replica weight-update sharding work (arxiv
+2004.13336) both argue for MECHANICAL consistency checking of
+sharding/collective structure; this package is that check, run before a
+multi-hour TPU job instead of during its postmortem.
+
+Two engines:
+
+- **Engine 1 -- source AST rules** (:mod:`rules_source`, CLI
+  ``python -m apex_tpu.lint [--strict]``): walks ``apex_tpu/`` +
+  ``examples/`` + ``benchmarks/`` and enforces the named, individually
+  suppressable rules (``comm-scope``, ``grad-collective``,
+  ``pallas-interpret``, ``module-citation``, ``bare-block-until-ready``,
+  ``exception-retention``). Wired into tier-1 as tests/test_lint.py: the
+  repo must lint clean, every suppression justified.
+- **Engine 2 -- jaxpr/trace analyzers** (:mod:`trace`): hazards XLA
+  compiles without complaint -- :func:`trace.lane_padding_report` (bytes
+  lost to T(8,128) minor-dim padding), :func:`trace.transpose_hazards`
+  (a collective of the loss inside the differentiated region, found as an
+  extra scalar psum in the backward jaxpr), and
+  :func:`trace.recompile_hazards` (weak-type / python-scalar signature
+  churn). Wired into ``monitor.selftest`` and the
+  ``benchmarks/gpt_scaling.py`` per-config report.
+
+No reference-file citation: the reference (NVIDIA Apex) ships no static
+analysis; the rule set encodes this repo's own conventions (CLAUDE.md,
+parallel/collectives.py:20-24, ops/flash_attention.py lane-padding notes).
+"""
+
+from apex_tpu.lint.findings import Finding, LintReport, Suppressions  # noqa: F401
+from apex_tpu.lint.rules_source import (  # noqa: F401
+    RULES,
+    comm_scope_check,
+    repo_root,
+    run_paths,
+)

@@ -1,0 +1,9 @@
+"""Module entry point: ``python -m apex_tpu.lint`` (see cli.py).
+
+No reference analog (package docstring)."""
+
+import sys
+
+from apex_tpu.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,95 @@
+"""``python -m apex_tpu.lint`` -- run the source-invariant linter.
+
+Engine 1 only: the trace analyzers (``apex_tpu.lint.trace``) need a live
+step function and example args, so they ship as an API (wired into
+``monitor.selftest`` and the ``benchmarks/gpt_scaling.py`` per-config
+report) rather than a file-walking CLI mode.
+
+Usage::
+
+    python -m apex_tpu.lint                  # lint the default trees
+    python -m apex_tpu.lint --strict         # exit 1 on unsuppressed findings
+    python -m apex_tpu.lint path/to/file.py  # lint specific files/dirs
+    python -m apex_tpu.lint --rules comm-scope,grad-collective
+    python -m apex_tpu.lint --list-rules
+    python -m apex_tpu.lint --json           # one JSON line (CI artifact)
+
+No reference analog (see package docstring).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from apex_tpu.lint.rules_source import DEFAULT_TREES, RULES, run_paths
+
+
+def _list_rules(out) -> None:
+    width = max(len(n) for n in RULES) + 2
+    print("source rules (engine 1, suppress with "
+          "'# lint: disable=<rule> -- why'):", file=out)
+    for name in sorted(RULES):
+        print(f"  {name:<{width}}{RULES[name][1]}", file=out)
+    print("\ntrace analyzers (engine 2, API -- apex_tpu.lint.trace):",
+          file=out)
+    for name, what in (
+        ("lane-padding", "lane_padding_report(fn, *args): bytes lost to "
+                         "T(8,128) minor-dim padding at HBM/custom-call "
+                         "boundaries"),
+        ("grad-transpose", "transpose_hazards(loss_fn, *args, axes=...): "
+                           "extra scalar psum/pmean in the backward jaxpr"),
+        ("recompile-hazard", "recompile_hazards(*step_args): weak-type/"
+                             "python-scalar leakage in the jit signature"),
+    ):
+        print(f"  {name:<{width}}{what}", file=out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.lint",
+        description="apex_tpu project-invariant linter (engine 1: source "
+                    "AST rules; see --list-rules for the trace-analyzer "
+                    "API).")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: the "
+                        f"{'/'.join(DEFAULT_TREES)} trees)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 if any unsuppressed violation remains (CI)")
+    p.add_argument("--rules", type=str, default=None,
+                   help="comma-separated rule subset")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--json", action="store_true",
+                   help="print one JSON line instead of per-line findings")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed findings with justifications")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        _list_rules(sys.stdout)
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    try:
+        report = run_paths(paths=args.paths or None, rules=rules)
+    except ValueError as e:  # unknown rule name or nonexistent path
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(report.to_json())
+    else:
+        for f in report.findings:
+            if f.suppressed and not args.show_suppressed:
+                continue
+            print(f.format())
+        print(f"{len(report.errors)} finding(s) "
+              f"({len(report.suppressed)} suppressed) in "
+              f"{report.files_scanned} files; rules: "
+              f"{', '.join(report.rules_run)}")
+    return 1 if (args.strict and report.errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

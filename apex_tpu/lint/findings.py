@@ -1,0 +1,161 @@
+"""Finding/suppression/report primitives shared by both lint engines.
+
+No reference analog (the reference ships no static analysis); this package
+mechanizes the invariants CLAUDE.md records in prose — the veScale-style
+consistency checking of sharding/collective structure (PAPERS.md, arxiv
+2509.07003) applied to this repo's own hard-won rules.
+
+Suppression grammar (engine 1): a violation is silenced by a comment on the
+flagged line (for multi-line statements: the statement's first line), or by
+a comment-only directive line directly above it --
+
+    ``# lint: disable=<rule>[,<rule>...] -- <one-line justification>``
+
+or for a whole file, anywhere in it --
+
+    ``# lint: disable-file=<rule>[,...] -- <one-line justification>``
+
+The justification text is carried on the suppressed finding; the tier-1
+repo-clean test (tests/test_lint.py) rejects suppressions without one, so
+every waiver in the tree is self-documenting.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable(?P<scope>-file)?=(?P<rules>[\w,-]+)"
+    r"(?:\s*--\s*(?P<why>.*\S))?"
+)
+
+
+@dataclass
+class Finding:
+    """One rule violation (or hazard, for the trace analyzers)."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def format(self) -> str:
+        tag = "  [suppressed"
+        tag += f": {self.justification}]" if self.justification else "]"
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+                + (tag if self.suppressed else ""))
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "message": self.message}
+        if self.suppressed:
+            d["suppressed"] = True
+            d["justification"] = self.justification
+        return d
+
+
+class Suppressions:
+    """Per-file suppression table parsed from source comments."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Dict[str, str]] = {}
+        self.file_wide: Dict[str, str] = {}
+        pending: Dict[str, str] = {}  # from comment-only directive lines
+        for lineno, comment, has_code in self._scan(source):
+            m = _SUPPRESS_RE.search(comment) if comment else None
+            if m:
+                why = (m.group("why") or "").strip()
+                rules = [r.strip() for r in m.group("rules").split(",")
+                         if r.strip()]
+                if m.group("scope"):
+                    target = self.file_wide
+                elif not has_code:
+                    # comment-only directive: binds to the next code line
+                    target = pending
+                else:
+                    row = self.by_line.setdefault(lineno, {})
+                    row.update(pending)  # a directive above ALSO binds here
+                    pending = {}
+                    target = row
+                for r in rules:
+                    target[r] = why
+            elif pending and has_code:
+                self.by_line.setdefault(lineno, {}).update(pending)
+                pending = {}
+
+    @staticmethod
+    def _scan(source: str) -> Iterable[Tuple[int, Optional[str], bool]]:
+        """``(lineno, comment_text, has_code)`` per interesting line, from
+        real tokens -- so a directive quoted inside a docstring or string
+        literal is documentation, not a live suppression."""
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            # untokenizable (reported as parse-error upstream): fall back
+            # to a raw scan rather than silently losing waivers
+            for lineno, text in enumerate(source.splitlines(), start=1):
+                stripped = text.strip()
+                yield lineno, text, bool(stripped) and not stripped.startswith("#")
+            return
+        comment_at: Dict[int, str] = {}
+        code_at = set()
+        for tok in tokens:
+            row = tok.start[0]
+            if tok.type == tokenize.COMMENT:
+                comment_at[row] = tok.string
+            elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                                  tokenize.INDENT, tokenize.DEDENT,
+                                  tokenize.ENDMARKER):
+                code_at.add(row)
+        for row in sorted(set(comment_at) | code_at):
+            yield row, comment_at.get(row), row in code_at
+
+    def match(self, rule: str, line: int) -> Optional[Tuple[bool, str]]:
+        """``(True, justification)`` when ``rule`` is silenced at ``line``."""
+        row = self.by_line.get(line, {})
+        for table in (row, self.file_wide):
+            for key in (rule, "all"):
+                if key in table:
+                    return True, table[key]
+        return None
+
+
+@dataclass
+class LintReport:
+    """Aggregated engine output: findings + scan provenance."""
+
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    rules_run: List[str] = field(default_factory=list)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def counts_by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.errors:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "files_scanned": self.files_scanned,
+            "rules_run": self.rules_run,
+            "errors": len(self.errors),
+            "suppressed": len(self.suppressed),
+            "by_rule": self.counts_by_rule(),
+            "findings": [f.to_dict() for f in self.findings],
+        })

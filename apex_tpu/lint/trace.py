@@ -1,0 +1,352 @@
+"""Engine 2: jaxpr-level hazard analyzers for jitted step functions.
+
+Hazards XLA will compile without complaint but that this repo has paid for
+on chip (PERF_NOTES.md, CLAUDE.md gotchas):
+
+- ``lane-padding``     (:func:`lane_padding_report`) -- bytes lost to the
+  T(8,128) minor-dim tiling at HBM/custom-call boundaries: a ``(b,h,sq,1)``
+  f32 operand occupies 128x its ``nbytes`` (2 GB for 16 MB of lse at 512k
+  tokens), ``d=32`` heads pad 4x. Uses the same tiling rules as the
+  resident-layout estimator in ``ops/flash_attention.py``
+  (``_resident_vmem_bytes``, exported ``NUM_LANES``) via
+  ``monitor.hbm.lane_padded_bytes``.
+- ``grad-transpose``   (:func:`transpose_hazards`) -- a ``psum``/``pmean``
+  of the scalar loss inside the differentiated region: its transpose shows
+  up as an EXTRA scalar collective in the backward jaxpr and over-counts
+  gradients by the axis size under ``check_vma=False``
+  (parallel/collectives.py conventions; the identity-backward wrapper in
+  tensor_parallel/mappings.py:62-79 leaves no backward collective).
+- ``recompile-hazard`` (:func:`recompile_hazards`) -- weak-type / python-
+  scalar leakage in a step signature, the shape/dtype churn the
+  ``monitor.diagnose.RecompileTracker`` counts at runtime; this scanner
+  names the offending leaves before the first recompile.
+
+All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
+device work) and return plain dicts/lists of findings shaped like engine
+1's (rule/message), so CLI and journal consumers render them uniformly.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from apex_tpu.monitor.hbm import lane_padded_bytes
+
+
+def _num_lanes() -> int:
+    """The 128-lane vreg width, read from the SAME module whose tiling
+    rule computes the padded bytes (monitor/hbm.py) so hint text and byte
+    math can never disagree; flash_attention's exported calibration
+    constants are pinned consistent with it by tests/test_lint.py."""
+    from apex_tpu.monitor import hbm
+
+    return int(getattr(hbm, "_NUM_LANES", 128))
+
+
+# ---------------------------------------------------------------------------
+# jaxpr traversal
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(eqn) -> List[Any]:
+    """Every inner jaxpr of a call-like equation (pjit, scan, while, cond,
+    shard_map, custom_vjp, pallas_call, ...) -- all branches, no multipliers:
+    these analyzers report presence/residency, not totals per step."""
+    import jax
+
+    out = []
+
+    def collect(v):
+        if isinstance(v, jax.extend.core.ClosedJaxpr):
+            out.append(v.jaxpr)
+        elif hasattr(v, "eqns"):  # open Jaxpr (remat, pallas_call)
+            out.append(v)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                collect(item)
+
+    for v in eqn.params.values():
+        collect(v)
+    return out
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """Depth-first over every equation, descending into inner jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def _aval_of(var):
+    return getattr(var, "aval", None)
+
+
+def _aval_bytes(aval) -> Tuple[int, int]:
+    """(logical nbytes, lane-padded nbytes) of one shaped aval."""
+    import numpy as np
+
+    shape = tuple(int(d) for d in aval.shape)
+    itemsize = int(np.dtype(aval.dtype).itemsize)
+    n = itemsize
+    for d in shape:
+        n *= d
+    return n, lane_padded_bytes(shape, itemsize)
+
+
+# ---------------------------------------------------------------------------
+# lane-padding waste auditor
+# ---------------------------------------------------------------------------
+
+
+def _audit_aval(aval, where: str, threshold: float, min_bytes: int):
+    try:
+        nb, pb = _aval_bytes(aval)
+    except Exception:  # noqa: BLE001 - tokens/abstract avals have no bytes
+        return None
+    if getattr(aval, "size", 0) <= 1:
+        return None  # a scalar cannot avoid its one tile; pure noise
+    if nb <= 0 or pb < threshold * nb or (pb - nb) < min_bytes:
+        return None
+    shape = tuple(int(d) for d in aval.shape)
+    lanes = _num_lanes()
+    hints = []
+    if len(shape) >= 1 and shape[-1] < lanes:
+        hints.append(f"minor dim {shape[-1]} pads to {lanes} lanes")
+        if shape[-1] == 1:
+            hints.append("carry per-row stats as dense (rows, blk) tables, "
+                         "not (rows, 1) columns (flash_attention.py lse/delta)")
+        elif 1 < shape[-1] < lanes:
+            hints.append("prefer minor dims that are multiples of 128 "
+                         "(e.g. head_dim 128 at extreme sequence lengths)")
+    if len(shape) >= 2 or not hints:
+        import numpy as np
+
+        sublanes = max(32 // int(np.dtype(aval.dtype).itemsize), 1)
+        second = shape[-2] if len(shape) >= 2 else 1
+        if second % sublanes:
+            hints.append(f"second-minor dim {second} pads to a multiple of "
+                         f"{sublanes} sublanes for {aval.dtype}")
+    msg = (f"{where}: {shape} {aval.dtype} occupies {pb} bytes under "
+           f"T(8,128) tiling ({round(pb / nb, 1)}x its {nb})")
+    return {
+        "rule": "lane-padding",
+        "where": where,
+        "shape": list(shape),
+        "dtype": str(aval.dtype),
+        "bytes": nb,
+        "padded_bytes": pb,
+        "waste_ratio": round(pb / nb, 2),
+        "message": msg + ("; " + "; ".join(hints) if hints else ""),
+    }
+
+
+# the call-like primitives whose operands/results XLA materializes in the
+# padded HBM layout (jaxpr primitive names: "custom_call" itself is an
+# HLO-level op and never appears in a jaxpr)
+_BOUNDARY_PRIMS = ("pallas_call", "ffi_call", "pure_callback", "io_callback")
+
+
+def lane_padding_report(fn, *args,
+                        threshold: float = 2.0,
+                        min_bytes: int = 1 << 16,
+                        max_findings: int = 20,
+                        axes: Optional[Dict[str, int]] = None,
+                        **kwargs) -> Dict[str, Any]:
+    """Estimate bytes lost to T(8,128) minor-dim padding in ``fn(*args)``.
+
+    Audits the step signature (top-level invars/outvars -- those arrays are
+    HBM-resident between steps) and every operand/result of custom-call
+    boundaries (``pallas_call`` et al., where XLA materializes the padded
+    layout -- the 2 GB-for-16 MB lse tax). ``fn`` may also be a
+    ``ClosedJaxpr``. Intermediates fused by XLA are NOT flagged: padding
+    only becomes real at residency/boundary points.
+
+    Returns ``{findings, waste_bytes, audited, findings_truncated}`` with
+    findings sorted by wasted bytes, worst first; ``findings_truncated``
+    counts drops beyond ``max_findings`` (never silently).
+    """
+    import jax
+
+    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
+        jaxpr = fn.jaxpr
+    else:
+        env = list(axes.items()) if axes else None
+        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    findings: List[Dict[str, Any]] = []
+    audited = 0
+    seen = set()
+
+    def audit(var, where):
+        nonlocal audited
+        aval = _aval_of(var)
+        if aval is None or not hasattr(aval, "shape"):
+            return
+        key = (where, tuple(getattr(aval, "shape", ())), str(getattr(aval, "dtype", "")))
+        if key in seen:
+            return
+        seen.add(key)
+        audited += 1
+        f = _audit_aval(aval, where, threshold, min_bytes)
+        if f is not None:
+            findings.append(f)
+
+    for i, v in enumerate(jaxpr.invars):
+        audit(v, f"input[{i}]")
+    for i, v in enumerate(jaxpr.outvars):
+        audit(v, f"output[{i}]")
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in _BOUNDARY_PRIMS:
+            continue
+        for v in eqn.invars:
+            audit(v, f"{name} operand")
+        for v in eqn.outvars:
+            audit(v, f"{name} result")
+
+    findings.sort(key=lambda f: f["bytes"] - f["padded_bytes"])
+    truncated = max(0, len(findings) - max_findings)
+    waste = sum(f["padded_bytes"] - f["bytes"] for f in findings)
+    return {
+        "findings": findings[:max_findings],
+        "waste_bytes": waste,
+        "audited": audited,
+        "findings_truncated": truncated,
+    }
+
+
+# ---------------------------------------------------------------------------
+# collective-transpose hazard detector
+# ---------------------------------------------------------------------------
+
+_LOSS_COLLECTIVES = ("psum", "pmean", "pmax", "pmin")
+
+
+def scalar_collective_counts(jaxpr) -> Dict[str, int]:
+    """Count psum/pmean-family equations whose operands are all scalar
+    (size <= 1) -- loss-shaped collectives. pmean lowers to psum+div, so
+    both traces of a comparison see the same primitive names."""
+    counts: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in _LOSS_COLLECTIVES:
+            continue
+        sizes = [int(getattr(_aval_of(v), "size", 0) or 0)
+                 for v in eqn.invars if _aval_of(v) is not None]
+        if sizes and all(s <= 1 for s in sizes):
+            counts[eqn.primitive.name] += 1
+    return dict(counts)
+
+
+def transpose_hazards(loss_fn, *args,
+                      axes: Optional[Dict[str, int]] = None,
+                      argnums=0, **kwargs) -> Dict[str, Any]:
+    """Detect a psum/pmean of the loss inside the differentiated region.
+
+    Traces ``loss_fn`` twice under ``axes`` (name -> size bindings, e.g.
+    ``{"data": 8}``): once plain, once under ``jax.value_and_grad``. A bare
+    ``pmean(loss)`` leaves an EXTRA scalar collective in the grad trace
+    (its transpose); the identity-backward psum
+    (``reduce_from_tensor_model_parallel_region``) leaves none. ``loss_fn``
+    that binds its own axes (shard_map inside) needs no ``axes``.
+
+    Returns ``{hazard, forward, grad, extra_in_backward, findings}``.
+    """
+    import jax
+
+    env = list(axes.items()) if axes else None
+    fwd = scalar_collective_counts(
+        jax.make_jaxpr(loss_fn, axis_env=env)(*args, **kwargs).jaxpr)
+    grad_fn = jax.value_and_grad(loss_fn, argnums=argnums)
+    bwd = scalar_collective_counts(
+        jax.make_jaxpr(grad_fn, axis_env=env)(*args, **kwargs).jaxpr)
+    extra = {k: bwd[k] - fwd.get(k, 0) for k in bwd
+             if bwd[k] > fwd.get(k, 0)}
+    findings = [{
+        "rule": "grad-transpose",
+        "message": f"backward jaxpr carries {n} extra scalar {verb} -- a "
+                   f"bare collective of the loss was differentiated; its "
+                   f"transpose over-counts gradients by the axis size "
+                   f"(reduce AFTER grad, or use the identity-backward "
+                   f"psum from tensor_parallel/mappings.py)",
+        "verb": verb, "extra": n,
+    } for verb, n in sorted(extra.items())]
+    return {"hazard": bool(extra), "forward": fwd, "grad": bwd,
+            "extra_in_backward": extra, "findings": findings}
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard scanner
+# ---------------------------------------------------------------------------
+
+
+def recompile_hazards(*args, **kwargs) -> List[Dict[str, Any]]:
+    """Scan a step-function argument pytree for signature churn sources.
+
+    Flags python scalars (weak-typed: alternating them with committed
+    arrays, or marking them static, recompiles per value/dtype) and
+    weak-typed jax arrays (a ``2.0 * x``-style leaf whose signature differs
+    from an explicitly-dtyped array -- the churn
+    ``monitor.diagnose.RecompileTracker`` counts after the fact). Pass the
+    exact args the jitted step receives.
+    """
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    findings: List[Dict[str, Any]] = []
+    for label, tree in (("args", args), ("kwargs", kwargs)):
+        leaves, _ = tree_flatten_with_path(tree)
+        for path, leaf in leaves:
+            where = f"{label}{keystr(path)}"
+            if isinstance(leaf, (bool, int, float, complex)):
+                findings.append({
+                    "rule": "recompile-hazard", "where": where,
+                    "kind": "python-scalar",
+                    "message": f"{where} is a python {type(leaf).__name__} -- "
+                               f"weak-typed in the jit signature; pass a "
+                               f"jnp array with an explicit dtype so the "
+                               f"cache key is stable (RecompileTracker "
+                               f"shape-churn class)",
+                })
+            elif isinstance(leaf, jax.Array) and getattr(leaf, "weak_type", False):
+                findings.append({
+                    "rule": "recompile-hazard", "where": where,
+                    "kind": "weak-type",
+                    "message": f"{where} is a weak-typed {leaf.dtype} array "
+                               f"-- its signature differs from a committed "
+                               f"array of the same dtype, churning the jit "
+                               f"cache; build it with an explicit dtype",
+                })
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# composite report (the gpt_scaling.py per-config wiring)
+# ---------------------------------------------------------------------------
+
+
+def step_report(fn, *args,
+                axes: Optional[Dict[str, int]] = None,
+                top: int = 3,
+                threshold: float = 2.0,
+                min_bytes: int = 1 << 16,
+                **kwargs) -> Dict[str, Any]:
+    """Compact per-config hazard report for a full train step: lane-padding
+    summary (worst ``top`` offenders) + signature recompile hazards.
+    ``kwargs`` are the step function's own keyword args (scanned like
+    ``args``). The transpose detector needs the raw loss function, not the
+    train step -- run :func:`transpose_hazards` on that separately."""
+    pad = lane_padding_report(fn, *args, axes=axes, threshold=threshold,
+                              min_bytes=min_bytes, **kwargs)
+    return {
+        "lane_padding": {
+            "waste_bytes": pad["waste_bytes"],
+            "flagged": len(pad["findings"]) + pad["findings_truncated"],
+            "audited": pad["audited"],
+            "worst": [{k: f[k] for k in
+                       ("where", "shape", "dtype", "waste_ratio",
+                        "padded_bytes")}
+                      for f in pad["findings"][:top]],
+        },
+        "recompile_hazards": recompile_hazards(*args, **kwargs),
+    }

@@ -18,10 +18,17 @@ that the monitor pieces stay importable and functional:
    non-finite parameter group; the recompile tracker counts a cache miss
    per fresh argument shape;
 7. report: the analysis CLI summarizes a journal and the compare gate
-   exits non-zero exactly on regression.
+   exits non-zero exactly on regression;
+8. lint: the source-invariant linter (``apex_tpu.lint``) reports the tree
+   clean (all suppressions justified) and the trace analyzers reproduce
+   the known hazards — the d=32/(sq,1) lane-padding numbers, the bare
+   ``pmean(loss)``-under-grad transpose, python-scalar signature leaks.
 
 Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
 proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
+
+No reference-file citation: like the rest of apex_tpu.monitor, the
+reference has no telemetry layer (monitor/__init__.py).
 """
 
 from __future__ import annotations
@@ -249,6 +256,61 @@ def _check_report() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _check_lint() -> dict:
+    import jax.numpy as jnp
+    from jax import lax
+
+    from apex_tpu import lint
+    from apex_tpu.lint import trace as lint_trace
+
+    # engine 1: the tree itself must lint clean, with every suppression
+    # carrying a justification (the same contract tests/test_lint.py
+    # enforces in tier-1; here it also rides dryrun_multichip)
+    rep = lint.run_paths()
+    assert not rep.errors, [f.format() for f in rep.errors[:5]]
+    assert rep.files_scanned >= 100, rep.files_scanned
+    assert set(rep.rules_run) == set(lint.RULES), rep.rules_run
+    assert all(f.justification for f in rep.suppressed), [
+        f.format() for f in rep.suppressed if not f.justification]
+
+    # engine 2, lane padding: the calibrated taxes — d=32 pads 4x to 128
+    # lanes; a (512, 1) f32 column occupies 512*128*4 bytes
+    pad = lint_trace.lane_padding_report(
+        lambda q, w: (q * 2.0).sum() + w.sum(),
+        jnp.ones((2, 4, 128, 32), jnp.float32),
+        jnp.ones((512, 1), jnp.float32), min_bytes=0)
+    by_shape = {tuple(f["shape"]): f for f in pad["findings"]}
+    assert by_shape[(2, 4, 128, 32)]["waste_ratio"] == 4.0, pad
+    assert by_shape[(512, 1)]["padded_bytes"] == 512 * 128 * 4, pad
+
+    # engine 2, transpose hazard: bare pmean(loss) under grad leaves an
+    # extra scalar collective in the backward; the identity-backward psum
+    # (the pipeline loss-aggregation wrapper) leaves none
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        reduce_from_tensor_model_parallel_region)
+
+    def bare(x):
+        return lax.pmean(jnp.sum(x * x), "i")
+
+    def wrapped(x):
+        return reduce_from_tensor_model_parallel_region(jnp.sum(x * x), "i")
+
+    x = jnp.ones((4,), jnp.float32)
+    hz = lint_trace.transpose_hazards(bare, x, axes={"i": 8})
+    assert hz["hazard"] and hz["extra_in_backward"], hz
+    assert not lint_trace.transpose_hazards(wrapped, x, axes={"i": 8})["hazard"]
+
+    # engine 2, recompile scan: python scalars and weak-typed leaves are
+    # named by pytree path; committed arrays pass
+    haz = lint_trace.recompile_hazards(
+        {"scale": 2.0, "x": jnp.ones((2,), jnp.float32)},
+        weak=jnp.asarray(1.0))
+    assert sorted(h["kind"] for h in haz) == ["python-scalar", "weak-type"], haz
+    return {"ok": True, "files": rep.files_scanned,
+            "suppressed": len(rep.suppressed),
+            "padding_waste_bytes": pad["waste_bytes"]}
+
+
 def run() -> dict:
     """In-process smoke (no platform mutation — safe under any backend)."""
     results = {}
@@ -258,7 +320,8 @@ def run() -> dict:
                      ("comms", _check_comms),
                      ("mfu", _check_mfu),
                      ("diagnose", _check_diagnose),
-                     ("report", _check_report)):
+                     ("report", _check_report),
+                     ("lint", _check_lint)):
         try:
             results[name] = fn()
         except Exception as e:  # noqa: BLE001 - report, don't crash the gate

@@ -1464,6 +1464,19 @@ def _resident_vmem_bytes(sq, sk, d, blk_q, blk_k, itemsize, has_bias,
     return max(fwd, dkv)
 
 
+# ---------------------------------------------------------------------------
+# lint/analyzer introspection hooks (apex_tpu.lint.trace lane-padding
+# auditor; monitor/hbm.py documents the same tiling for HBM): the lane and
+# sublane constants the 'auto' layout decision compiles by, and the
+# resident-layout residency estimator, public so analyzers estimate with
+# the exact rules this kernel is calibrated against.
+# ---------------------------------------------------------------------------
+
+NUM_LANES = _NUM_LANES
+NUM_SUBLANES = _NUM_SUBLANES
+resident_vmem_bytes = _resident_vmem_bytes
+
+
 # Measurement basis of the stream='auto' throughput crossover: d=64 bf16
 # on-chip fwd+bwd. The re-streamed q/do rows move LANE-PADDED bytes
 # (minor dim pads to the 128-lane vreg width, same rule as

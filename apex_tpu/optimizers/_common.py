@@ -1,4 +1,11 @@
-"""Shared plumbing for fused optimizers."""
+"""Shared plumbing for fused optimizers.
+
+Reference: the tensor-list iteration apex repeats per optimizer over
+``csrc/multi_tensor_apply.cuh`` (each apex/optimizers/*.py class walks
+grouped param/grad/state lists through one fused CUDA launch); here that
+pattern is hoisted once as pytree maps — :func:`multi_tree_map` is the
+structural analog of a multi-tensor kernel emitting several output lists.
+"""
 
 from __future__ import annotations
 

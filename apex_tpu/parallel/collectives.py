@@ -36,6 +36,23 @@ from apex_tpu.monitor.comms import collective_scope as _comm
 
 AxisNames = Union[str, Tuple[str, ...]]
 
+# ---------------------------------------------------------------------------
+# lint introspection hooks (apex_tpu.lint comm-scope rule; read STATICALLY
+# via ast.literal_eval, so keep both values plain literals). The prims are
+# the data-moving named-axis collectives -- axis_index/axis_size are
+# rank/topology queries, not communication; the helpers are the call names
+# that satisfy the comm:-scope contract documented above.
+# ---------------------------------------------------------------------------
+
+COMM_SCOPE_PRIMS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                    "psum_scatter", "ppermute", "all_to_all", "pshuffle",
+                    "all_gather_invariant"}
+COMM_SCOPE_HELPERS = ("_comm", "collective_scope")
+
+#: every verb in this module must run under a ``comm:`` scope; the marker
+#: opts the file into the lint rule even if the import shape changes
+LINT_COMM_SCOPE = True
+
 
 def axis_rank(axis: AxisNames) -> jax.Array:
     """This shard's index along ``axis`` (torch.distributed.get_rank(group)

@@ -120,7 +120,8 @@ def allreduce_gradients_by_spec(
             g = g / denom
         extra = tuple(a for a in replicated_axes if a not in spec_axes)
         if extra:
-            g = lax.psum(g, extra)
+            with _comm("psum", extra, g):
+                g = lax.psum(g, extra)
         return g
 
     from jax.sharding import PartitionSpec

@@ -30,6 +30,10 @@ from apex_tpu.monitor.comms import collective_scope as _comm
 from apex_tpu.parallel.mesh import AXIS_MODEL
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
+#: lint introspection hook: every conjugate collective here must run under
+#: a ``comm:`` scope (apex_tpu.lint comm-scope rule, statically detected)
+LINT_COMM_SCOPE = True
+
 
 def _local_slice(x, axis_name: str, dim: int = -1):
     """This rank's chunk of ``x`` along ``dim`` (mappings.py _split, :75-87)."""

@@ -1,1 +1,5 @@
-"""Shared utilities: logging, checkpointing, timers."""
+"""Shared utilities: logging, jax-version compat shims, small nn helpers.
+
+No reference-file citation: host-side conveniences the reference gets from
+torch builtins; each submodule documents its own mapping where one exists.
+"""

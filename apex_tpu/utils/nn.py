@@ -1,4 +1,10 @@
-"""Small shared nn helpers."""
+"""Small shared nn helpers.
+
+No reference-file citation of their own: :func:`inverted_dropout` preserves
+``torch.nn.functional.dropout`` semantics (inverted scaling, identity at
+eval) that the reference's modules rely on implicitly; callers cite the
+module whose behavior they reproduce.
+"""
 
 from __future__ import annotations
 

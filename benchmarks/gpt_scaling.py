@@ -165,6 +165,17 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             row["mfu"]["flops_method"] = costs["method"]
         except Exception as e:  # noqa: BLE001 - mfu is best-effort evidence
             row["mfu"] = {"error": str(e)[:120]}
+        try:
+            # static hazard scan per config (apex_tpu/lint/trace.py):
+            # lane-padding waste at HBM/custom-call boundaries of THIS
+            # step's jaxpr + weak-type/python-scalar signature leaks.
+            # Trace-time only — one extra make_jaxpr, no compile.
+            from apex_tpu.lint import trace as lint_trace
+
+            row["static_hazards"] = lint_trace.step_report(
+                train_step, params, opt_state, toks, tgts)
+        except Exception as e:  # noqa: BLE001 - hazard scan is best-effort
+            row["static_hazards"] = {"error": str(e)[:120]}
         return row
     finally:
         mesh_lib.destroy_model_parallel()
@@ -216,6 +227,14 @@ _TABLE_NOTES = {
         "APEX_TPU_PEAK_FLOPS / APEX_TPU_PEAK_HBM_GBPS). peak_source "
         "'table:cpu' marks a virtual-mesh emulation number, not a TPU "
         "utilization claim."),
+    "static_hazards": (
+        "per-config jaxpr hazard scan (apex_tpu/lint/trace.py): "
+        "lane_padding reports bytes lost to T(8,128) minor-dim tiling at "
+        "step-signature and custom-call boundaries (worst offenders with "
+        "waste ratios); recompile_hazards names weak-type/python-scalar "
+        "leaves in the jitted signature. Both trace-time estimates, "
+        "backend-independent - actionable on TPU even when measured on "
+        "the CPU mesh."),
     "overlap": (
         "overlap.async_pairs reflects the CPU backend's synchronous "
         "collective lowering, not TPU behavior. TPU-targeted async "

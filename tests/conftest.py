@@ -22,3 +22,13 @@ import jax  # noqa: E402
 # update here still wins.
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# The suite is written against the modern surface (``jax.shard_map`` with
+# ``check_vma=``, CLAUDE.md conventions); on jax < 0.5 that name lives
+# under jax.experimental with the flag spelled ``check_rep=``. Install the
+# repo's adapter (apex_tpu/utils/compat.py; no-op on modern jax) so the
+# same tests run on either vintage — the entrypoints (__graft_entry__,
+# gpt_scaling main) already do this for themselves.
+from apex_tpu.utils.compat import ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()

@@ -1,9 +1,10 @@
 """Tests for apex_tpu.monitor.diagnose — overflow/NaN forensics (per-group
 grad-norm attribution through the real MixedPrecisionOptimizer path),
 loss-spike triggers, the recompile/shape-churn tracker, and the static
-guarantee that every collective verb carries a ``comm:`` scope."""
+guarantee that every collective verb carries a ``comm:`` scope (the walker
+now lives in ``apex_tpu.lint`` as the named ``comm-scope`` rule; this file
+keeps only the thin invocation)."""
 
-import ast
 import os
 
 import jax
@@ -197,47 +198,11 @@ def test_recompile_tracker_preserves_results():
 
 
 # ---------------------------------------------------------------------------
-# static check: every collective verb carries a comm: scope
+# static check: every collective verb carries a comm: scope — the walker is
+# apex_tpu.lint's comm-scope rule now (promoted from this file's ad-hoc
+# version); the rule's prim/helper sets come from collectives.py itself
+# (COMM_SCOPE_PRIMS/COMM_SCOPE_HELPERS, read statically)
 # ---------------------------------------------------------------------------
-
-# the data-moving named-axis collectives (axis_index/axis_size are
-# rank/topology queries, not communication)
-_COMM_PRIMS = {"psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
-               "ppermute", "all_to_all", "pshuffle", "all_gather_invariant"}
-
-
-def _scope_violations(path):
-    """Functions that CALL a lax collective without ALSO calling the
-    ``comm:`` scope helper (``_comm`` / ``collective_scope``) somewhere in
-    their body — the accounting contract every verb must carry."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-
-    def calls_in(node, pred):
-        return [n for n in ast.walk(node)
-                if isinstance(n, ast.Call) and pred(n.func)]
-
-    def is_lax_collective(func):
-        return (isinstance(func, ast.Attribute)
-                and isinstance(func.value, ast.Name)
-                and func.value.id == "lax" and func.attr in _COMM_PRIMS)
-
-    def is_scope_helper(func):
-        name = getattr(func, "id", None) or getattr(func, "attr", None)
-        return name in ("_comm", "collective_scope")
-
-    violations, verbs = [], 0
-    for node in tree.body:
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        collectives = calls_in(node, is_lax_collective)
-        if not collectives:
-            continue
-        verbs += 1
-        if not calls_in(node, is_scope_helper):
-            violations.append(
-                (node.name, sorted({c.func.attr for c in collectives})))
-    return violations, verbs
 
 
 @pytest.mark.parametrize("relpath,min_verbs", [
@@ -247,10 +212,12 @@ def _scope_violations(path):
 ])
 def test_every_collective_verb_carries_comm_scope(relpath, min_verbs):
     """A future verb added to collectives.py/mappings.py without the
-    ``comm:`` scope would silently drop per-axis accounting; this static
-    check makes that a test failure instead."""
+    ``comm:`` scope would silently drop per-axis accounting; the named
+    lint rule makes that a test failure instead."""
+    from apex_tpu.lint import comm_scope_check
+
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    violations, verbs = _scope_violations(os.path.join(root, relpath))
+    violations, verbs = comm_scope_check(os.path.join(root, relpath))
     assert not violations, (
         f"collective verbs without a comm: scope in {relpath}: {violations}")
     # the check must actually be scanning verbs, not vacuously passing

@@ -1,0 +1,466 @@
+"""Tests for apex_tpu.lint — the project-invariant linter (engine 1: source
+AST rules) and the jaxpr hazard analyzers (engine 2: lane padding,
+collective-transpose, recompile hazards) — plus the tier-1 contract that the
+repo itself lints clean with every suppression justified."""
+
+import json
+import textwrap
+
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from apex_tpu.lint import RULES, Suppressions, comm_scope_check, run_paths
+from apex_tpu.lint import trace
+from apex_tpu.lint.cli import main as lint_main
+
+
+def _write(tmp_path, relpath, body):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(body))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 contract: the repo lints clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean_with_justified_suppressions():
+    """Every invariant the linter mechanizes must HOLD over the tree — an
+    unsuppressed finding here is a real regression of a documented
+    convention (CLAUDE.md), and a suppression without a justification is a
+    waiver nobody can audit."""
+    rep = run_paths()
+    assert not rep.errors, "\n".join(f.format() for f in rep.errors)
+    assert rep.files_scanned >= 100, rep.files_scanned
+    assert set(rep.rules_run) == set(RULES)
+    for f in rep.suppressed:
+        assert f.justification, f"unjustified suppression: {f.format()}"
+
+
+def test_cli_strict_exits_zero_on_repo(capsys):
+    assert lint_main(["--strict"]) == 0
+    assert lint_main(["--json"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(out)
+    assert payload["errors"] == 0
+    assert payload["files_scanned"] >= 100
+
+
+def test_cli_list_rules_and_unknown_rule(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in RULES:
+        assert name in out
+    assert "lane-padding" in out  # the trace analyzers are advertised
+    assert lint_main(["--rules", "not-a-rule"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance fixture: three distinct named rules on seeded hazards
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_hazards_flagged_by_three_named_rules(tmp_path):
+    """The ISSUE acceptance: a bare pmean(loss) under grad, a missing
+    comm: scope, and a (sq, 1) f32 operand are each flagged by a distinct
+    named rule (grad-collective, comm-scope, lane-padding)."""
+    bad = _write(tmp_path, "bad_step.py", '''
+        """Deliberately-hazardous fixture."""
+        import jax
+        from jax import lax
+
+        from apex_tpu.monitor.comms import collective_scope
+
+        def unscoped_verb(tree, axis):
+            return lax.psum(tree, axis)
+
+        def loss_fn(params, batch):
+            loss = lax.pmean((params * batch).sum(), "data")
+            return loss
+
+        step_grads = jax.grad(loss_fn)
+    ''')
+    rep = run_paths(paths=[str(bad)], root=str(tmp_path))
+    by_rule = {}
+    for f in rep.errors:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert "comm-scope" in by_rule, rep.findings
+    assert any("unscoped_verb" in m for m in by_rule["comm-scope"])
+    assert "grad-collective" in by_rule, rep.findings
+    assert any("pmean" in m for m in by_rule["grad-collective"])
+
+    # third distinct rule, engine 2: the (sq, 1) f32 operand
+    pad = trace.lane_padding_report(
+        lambda w: w * 2.0, jnp.ones((512, 1), jnp.float32), min_bytes=0)
+    flagged = [f for f in pad["findings"] if f["shape"] == [512, 1]]
+    assert flagged and flagged[0]["rule"] == "lane-padding"
+    assert {"comm-scope", "grad-collective", flagged[0]["rule"]} == {
+        "comm-scope", "grad-collective", "lane-padding"}
+
+
+# ---------------------------------------------------------------------------
+# engine 1 rules, one fixture each
+# ---------------------------------------------------------------------------
+
+
+def test_comm_scope_check_reports_violations_and_verbs(tmp_path):
+    path = _write(tmp_path, "verbs.py", '''
+        from jax import lax
+        from apex_tpu.monitor.comms import collective_scope as _comm
+
+        def good(tree, axis):
+            with _comm("psum", axis, tree):
+                return lax.psum(tree, axis)
+
+        def bad(tree, axis):
+            return lax.pmean(tree, axis)
+    ''')
+    violations, verbs = comm_scope_check(str(path))
+    assert verbs == 2
+    assert violations == [("bad", ["pmean"])]
+
+
+def test_comm_scope_skips_files_outside_contract(tmp_path):
+    # raw lax collectives WITHOUT the scope-helper import or marker are
+    # other rules' business (model code psums activations legitimately)
+    path = _write(tmp_path, "model.py", '''
+        from jax import lax
+
+        def stats(x, axis):
+            return lax.pmean(x, axis)
+    ''')
+    rep = run_paths(paths=[str(path)], root=str(tmp_path))
+    assert not [f for f in rep.findings if f.rule == "comm-scope"]
+
+
+def test_comm_scope_marker_opts_in(tmp_path):
+    path = _write(tmp_path, "marked.py", '''
+        from jax import lax
+
+        LINT_COMM_SCOPE = True
+
+        def verb(x, axis):
+            return lax.psum(x, axis)
+    ''')
+    rep = run_paths(paths=[str(path)], root=str(tmp_path))
+    assert [f for f in rep.errors if f.rule == "comm-scope"]
+
+
+def test_grad_collective_lambda_and_clean_variants(tmp_path):
+    path = _write(tmp_path, "grads.py", '''
+        import jax
+        from jax import lax
+        from apex_tpu.parallel import collectives
+
+        g1 = jax.value_and_grad(lambda p: collectives.pmean(p.sum(), "data"))
+
+        def clean_loss(p):
+            return p.sum() * 2.0
+
+        def train(p):
+            loss, grads = jax.value_and_grad(clean_loss)(p)
+            # reducing AFTER the grad call is the documented-correct shape
+            return collectives.pmean(loss, "data"), grads
+    ''')
+    rep = run_paths(paths=[str(path)], root=str(tmp_path))
+    hits = [f for f in rep.errors if f.rule == "grad-collective"]
+    assert len(hits) == 1 and "<lambda>" in hits[0].message
+
+
+def test_pallas_interpret_rule(tmp_path):
+    path = _write(tmp_path, "kern.py", '''
+        from jax.experimental import pallas as pl
+
+        def good(x):
+            return pl.pallas_call(kernel, out_shape=x, interpret=True)(x)
+
+        def bad(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+    ''')
+    rep = run_paths(paths=[str(path)], root=str(tmp_path))
+    hits = [f for f in rep.errors if f.rule == "pallas-interpret"]
+    assert len(hits) == 1 and hits[0].line == 8
+
+
+def test_module_citation_rule(tmp_path):
+    flagged = _write(tmp_path, "apex_tpu/nocite.py", '"""Does things."""\n')
+    cited = _write(tmp_path, "apex_tpu/cited.py",
+                   '"""X (reference: apex/foo/bar.py:10-20)."""\n')
+    waived = _write(tmp_path, "apex_tpu/waived.py",
+                    '"""Y. No reference analog: invented here."""\n')
+    outside = _write(tmp_path, "examples/nocite.py", '"""Free-form."""\n')
+    rep = run_paths(paths=[str(p) for p in (flagged, cited, waived, outside)],
+                    root=str(tmp_path))
+    hits = [f for f in rep.errors if f.rule == "module-citation"]
+    assert [f.path for f in hits] == ["apex_tpu/nocite.py"]
+
+
+def test_bare_block_until_ready_rule(tmp_path):
+    path = _write(tmp_path, "timing.py", '''
+        import time
+        import jax
+
+        def timed_loop(step, params):
+            t0 = time.perf_counter()
+            params = step(params)
+            jax.block_until_ready(params)
+            return time.perf_counter() - t0
+
+        def warmup_sync(params):
+            # no clock in this scope: a bare sync is fine here
+            jax.block_until_ready(params)
+    ''')
+    rep = run_paths(paths=[str(path)], root=str(tmp_path))
+    hits = [f for f in rep.errors if f.rule == "bare-block-until-ready"]
+    assert len(hits) == 1 and hits[0].line == 8
+
+
+def test_exception_retention_rule(tmp_path):
+    path = _write(tmp_path, "oom.py", '''
+        def retains(fn):
+            errs = []
+            try:
+                fn()
+            except Exception as e:
+                errs.append(e)
+            return errs
+
+        def stores(self, fn):
+            try:
+                fn()
+            except Exception as e:
+                self.last = e
+
+        def sanitizes(fn):
+            try:
+                fn()
+            except Exception as e:
+                return {"error": str(e)[:100]}
+    ''')
+    rep = run_paths(paths=[str(path)], root=str(tmp_path))
+    hits = [f for f in rep.errors if f.rule == "exception-retention"]
+    assert sorted(f.line for f in hits) == [7, 14]  # append + attr store
+    assert not any(f.line > 14 for f in hits)  # str(e) never flags
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_inline_and_comment_line_above(tmp_path):
+    path = _write(tmp_path, "sup.py", '''
+        from jax.experimental import pallas as pl
+
+        def a(x):
+            return pl.pallas_call(k)(x)  # lint: disable=pallas-interpret -- helper resolves it
+
+        def b(x):
+            # lint: disable=pallas-interpret -- wrapped by caller
+            return pl.pallas_call(k)(x)
+
+        def c(x):
+            return pl.pallas_call(k)(x)
+    ''')
+    rep = run_paths(paths=[str(path)], root=str(tmp_path))
+    hits = [f for f in rep.findings if f.rule == "pallas-interpret"]
+    assert len(hits) == 3
+    assert [f.suppressed for f in sorted(hits, key=lambda f: f.line)] == [
+        True, True, False]
+    assert all(f.justification for f in hits if f.suppressed)
+
+
+def test_suppression_file_wide():
+    sup = Suppressions(
+        "# lint: disable-file=comm-scope -- generated file\nx = 1\n")
+    assert sup.match("comm-scope", 99) == (True, "generated file")
+    assert sup.match("grad-collective", 99) is None
+
+
+def test_suppression_directive_inside_string_is_documentation():
+    """A directive quoted in a docstring or string literal documents the
+    grammar; it must never become a live file-wide waiver."""
+    sup = Suppressions(
+        '"""Grammar doc:\n'
+        "    # lint: disable-file=comm-scope -- generated file\n"
+        '"""\n'
+        "s = '# lint: disable=grad-collective -- also quoted'\n"
+        "x = 1\n")
+    assert sup.match("comm-scope", 5) is None
+    assert sup.match("grad-collective", 4) is None
+    assert sup.file_wide == {}
+
+
+def test_suppression_pending_does_not_leak_past_inline_directive():
+    """A comment-only directive above a line that carries its own inline
+    directive binds to THAT line (both apply) — it must not skip ahead and
+    waive an unrelated later violation."""
+    sup = Suppressions(
+        "# lint: disable=rule-a -- above\n"
+        "x = foo()  # lint: disable=rule-b -- inline\n"
+        "y = bar()\n")
+    assert sup.match("rule-a", 2) == (True, "above")
+    assert sup.match("rule-b", 2) == (True, "inline")
+    assert sup.match("rule-a", 3) is None
+
+
+def test_nonexistent_path_fails_loudly(tmp_path):
+    """A typo'd CI path must never lint 0 files and exit green."""
+    with pytest.raises(ValueError, match="does not exist"):
+        run_paths(paths=[str(tmp_path / "no_such_tree")])
+    assert lint_main(["--strict", str(tmp_path / "no_such_tree")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# engine 2: lane-padding auditor against the known numbers
+# ---------------------------------------------------------------------------
+
+
+def test_lane_padding_known_numbers():
+    """The satellite contract: d=32 pads 4x to 128 lanes; a (sq, 1) f32
+    window costs sq*128*4 resident bytes; a dense (b, h, nq, blk_q) lse
+    table is pad-free (the flash_attention streamed-kernel design)."""
+
+    def fn(q, w, lse):
+        return (q * 2.0).sum() + w.sum() + lse.sum()
+
+    q = jnp.ones((2, 4, 128, 32), jnp.float32)    # d=32 head
+    w = jnp.ones((512, 1), jnp.float32)           # (sq, 1) f32 window
+    lse = jnp.ones((2, 4, 8, 128), jnp.float32)   # dense (b, h, nq, blk_q)
+    rep = trace.lane_padding_report(fn, q, w, lse, min_bytes=0)
+    by_shape = {tuple(f["shape"]): f for f in rep["findings"]}
+
+    head = by_shape[(2, 4, 128, 32)]
+    assert head["waste_ratio"] == 4.0
+    assert head["padded_bytes"] == 4 * head["bytes"]
+    assert "pads to 128 lanes" in head["message"]
+
+    window = by_shape[(512, 1)]
+    assert window["padded_bytes"] == 512 * 128 * 4
+    assert window["waste_ratio"] == 128.0
+    assert "dense" in window["message"]  # the lse-table remediation hint
+
+    assert (2, 4, 8, 128) not in by_shape  # dense tables are pad-free
+    assert rep["audited"] >= 3
+    assert rep["waste_bytes"] == (head["padded_bytes"] - head["bytes"]
+                                  + window["padded_bytes"] - window["bytes"])
+
+
+def test_tiling_constants_single_source_of_truth():
+    """The auditor's byte math (monitor.hbm.lane_padded_bytes) and the
+    calibrated flash-attention constants it is documented against must
+    agree — if flash_attention ever recalibrates NUM_LANES/NUM_SUBLANES,
+    this failure is the signal to update the hbm tiling rule too, instead
+    of the two silently diverging."""
+    from apex_tpu.monitor.hbm import lane_padded_bytes
+    from apex_tpu.ops import flash_attention as fa
+
+    assert fa.NUM_LANES == 128 and fa.NUM_SUBLANES == 8
+    # one f32 tile row: lanes x sublanes x itemsize under both rule sets
+    assert lane_padded_bytes((1, 1), 4) == fa.NUM_LANES * fa.NUM_SUBLANES * 4
+    # the public resident-layout estimator counts the same lane padding
+    # the auditor reports: d=32 occupies a full 128-lane tile in K+V
+    sk, d, item = 2048, 32, 2
+    d_eff = -(-d // fa.NUM_LANES) * fa.NUM_LANES
+    assert fa.resident_vmem_bytes(2048, sk, d, 512, 512, item,
+                                  False, False) >= 2 * sk * d_eff * item
+
+
+def test_lane_padding_min_bytes_and_truncation():
+    def fn(w):
+        return w * 2.0
+
+    w = jnp.ones((8, 1), jnp.float32)  # 4 KB padded: under the default floor
+    assert not trace.lane_padding_report(fn, w)["findings"]
+    full = trace.lane_padding_report(fn, w, min_bytes=0, max_findings=1)
+    # input + output both flagged; truncation is reported, never silent
+    assert len(full["findings"]) == 1 and full["findings_truncated"] == 1
+
+
+def test_lane_padding_audits_pallas_boundaries():
+    """Operands crossing a pallas_call boundary are audited even when the
+    top-level signature is clean (the custom-call HBM-layout tax)."""
+    from apex_tpu.ops.softmax import scaled_masked_softmax
+
+    x = jnp.ones((2, 2, 8, 256), jnp.float32)  # minor dim 256: pad-free
+    rep = trace.lane_padding_report(
+        lambda a: scaled_masked_softmax(a, impl="pallas"), x)
+    assert rep["audited"] >= 4  # signature + pallas operands/results
+    assert not rep["findings"]
+
+
+# ---------------------------------------------------------------------------
+# engine 2: collective-transpose hazard detector
+# ---------------------------------------------------------------------------
+
+
+def test_transpose_hazard_flags_bare_pmean_under_grad():
+    def bare(x):
+        return lax.pmean(jnp.sum(x * x), "i")
+
+    hz = trace.transpose_hazards(bare, jnp.ones((4,)), axes={"i": 8})
+    assert hz["hazard"]
+    assert hz["extra_in_backward"] == {"psum": 1}  # pmean lowers to psum+div
+    assert hz["findings"][0]["rule"] == "grad-transpose"
+    assert "over-counts" in hz["findings"][0]["message"]
+
+
+def test_transpose_hazard_passes_identity_backward_psum():
+    """The pipeline loss aggregation uses the identity-backward psum
+    (reduce_from_tensor_model_parallel_region) — its custom_vjp leaves NO
+    collective in the backward, so it must not be flagged."""
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        reduce_from_tensor_model_parallel_region)
+
+    def wrapped(x):
+        return reduce_from_tensor_model_parallel_region(jnp.sum(x * x), "i")
+
+    hz = trace.transpose_hazards(wrapped, jnp.ones((4,)), axes={"i": 8})
+    assert not hz["hazard"], hz
+    assert hz["forward"].get("psum", 0) >= 1  # the forward psum WAS seen
+    assert hz["extra_in_backward"] == {}
+
+
+def test_transpose_hazard_ignores_nonscalar_collectives():
+    """psums of activations/grad tensors (e.g. the conjugate TP pair) are
+    not loss-shaped; only scalar collectives count."""
+    def loss(x):
+        y = lax.psum(x * 2.0, "i")  # activation psum: non-scalar
+        return jnp.sum(y * y)
+
+    hz = trace.transpose_hazards(loss, jnp.ones((4,)), axes={"i": 8})
+    assert hz["forward"] == {} and not hz["hazard"]
+
+
+# ---------------------------------------------------------------------------
+# engine 2: recompile-hazard scanner
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_hazards_name_offending_leaves():
+    haz = trace.recompile_hazards(
+        {"opt": {"loss_scale": 2.0 ** 16}, "x": jnp.ones((2,), jnp.float32)},
+        weak=jnp.asarray(1.0))
+    kinds = {h["kind"]: h for h in haz}
+    assert set(kinds) == {"python-scalar", "weak-type"}
+    assert "loss_scale" in kinds["python-scalar"]["where"]
+    assert kinds["weak-type"]["where"].startswith("kwargs")
+    assert all(h["rule"] == "recompile-hazard" for h in haz)
+
+
+def test_recompile_hazards_clean_signature():
+    assert trace.recompile_hazards(
+        jnp.ones((2, 2), jnp.bfloat16),
+        {"step": jnp.asarray(0, jnp.int32)}) == []
+
+
+def test_step_report_composite():
+    rep = trace.step_report(
+        lambda w, s: (w * s).sum(),
+        jnp.ones((512, 1), jnp.float32), 2.0, min_bytes=0)
+    assert rep["lane_padding"]["flagged"] >= 1
+    assert rep["lane_padding"]["worst"][0]["shape"] == [512, 1]
+    assert [h["kind"] for h in rep["recompile_hazards"]] == ["python-scalar"]
