@@ -23,10 +23,13 @@ Two engines:
   compiles without complaint -- :func:`trace.lane_padding_report` (bytes
   lost to T(8,128) minor-dim padding), :func:`trace.transpose_hazards`
   (a collective of the loss inside the differentiated region, found as an
-  extra scalar psum in the backward jaxpr), and
+  extra scalar psum in the backward jaxpr),
   :func:`trace.recompile_hazards` (weak-type / python-scalar signature
-  churn). Wired into ``monitor.selftest`` and the
-  ``benchmarks/gpt_scaling.py`` per-config report.
+  churn), and :func:`trace.sequence_parallel_hazards` (a psum of
+  activations on the TP axis inside a sequence-parallel forward -- the
+  psum_scatter/all_gather decomposition silently regressed). Wired into
+  ``monitor.selftest`` and the ``benchmarks/gpt_scaling.py`` per-config
+  report.
 
 No reference-file citation: the reference (NVIDIA Apex) ships no static
 analysis; the rule set encodes this repo's own conventions (CLAUDE.md,

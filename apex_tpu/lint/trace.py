@@ -20,6 +20,12 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   scalar leakage in a step signature, the shape/dtype churn the
   ``monitor.diagnose.RecompileTracker`` counts at runtime; this scanner
   names the offending leaves before the first recompile.
+- ``sp-regression``    (:func:`sequence_parallel_hazards`) -- a ``psum`` of
+  an ACTIVATION on the TP axis inside a sequence-parallel forward: the
+  mode's whole point is that those all-reduces decompose into
+  ``psum_scatter``/``all_gather`` conjugates
+  (tensor_parallel/mappings.py table 2), and a refactor that reintroduces
+  one compiles without complaint -- this scanner is the only tripwire.
 
 All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
 device work) and return plain dicts/lists of findings shaped like engine
@@ -273,6 +279,114 @@ def transpose_hazards(loss_fn, *args,
     } for verb, n in sorted(extra.items())]
     return {"hazard": bool(extra), "forward": fwd, "grad": bwd,
             "extra_in_backward": extra, "findings": findings}
+
+
+# ---------------------------------------------------------------------------
+# sequence-parallel decomposition tripwire
+# ---------------------------------------------------------------------------
+
+# the primitive names an eqn binds its axis under, per collective family
+_AXIS_PARAM_KEYS = ("axes", "axis_name")
+
+
+def _eqn_axis_names(eqn) -> Tuple[str, ...]:
+    """Named axes a collective equation reduces/moves over (psum binds
+    ``axes``; all_gather/reduce_scatter/all_to_all bind ``axis_name``)."""
+    for key in _AXIS_PARAM_KEYS:
+        if key in eqn.params:
+            v = eqn.params[key]
+            if isinstance(v, (tuple, list)):
+                return tuple(str(a) for a in v)
+            return (str(v),)
+    return ()
+
+
+def tp_collective_census(jaxpr, tp_axis: str,
+                         min_activation_rank: int = 3) -> Dict[str, Any]:
+    """Count collectives over ``tp_axis`` in a jaxpr, split into ACTIVATION
+    traffic (any operand of rank >= ``min_activation_rank`` -- the
+    ``(b, s, h)`` tensors whose all-reduce sequence parallelism decomposes)
+    and the rest (loss/softmax scalars and ``(b, s)`` reductions of the
+    vocab-parallel cross entropy, which legitimately stay psums)."""
+    activation: Counter = Counter()
+    other: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in ("psum", "pmean", "pmax", "pmin", "all_gather",
+                        "reduce_scatter", "all_to_all"):
+            continue
+        if tp_axis not in _eqn_axis_names(eqn):
+            continue
+        ranks = [len(getattr(_aval_of(v), "shape", ()) or ())
+                 for v in eqn.invars if _aval_of(v) is not None]
+        bucket = activation if ranks and max(ranks) >= min_activation_rank \
+            else other
+        bucket[name] += 1
+    return {"activation": dict(activation), "other": dict(other)}
+
+
+def sequence_parallel_hazards(fn, *args,
+                              tp_axis: str = "model",
+                              axes: Optional[Dict[str, int]] = None,
+                              num_layers: Optional[int] = None,
+                              min_activation_rank: int = 3,
+                              **kwargs) -> Dict[str, Any]:
+    """Verify a sequence-parallel FORWARD decomposed its TP all-reduces.
+
+    Traces ``fn(*args)`` under ``axes`` (name -> size bindings, e.g.
+    ``{"model": 2}``; omit when ``fn`` binds its own axes via shard_map)
+    and censuses collectives on ``tp_axis``. A ``psum``/``pmean`` whose
+    operand is activation-shaped (rank >= ``min_activation_rank``) is a
+    finding: under ``sequence_parallel=True`` every such all-reduce must
+    have become the ``reduce_scatter``/``all_gather`` conjugate pair
+    (``SEQUENCE_PARALLEL_DECOMPOSED_PRIMS``, parallel/collectives.py) --
+    XLA compiles the regression silently. Scalar/rank-2 psums (loss, the
+    vocab-parallel CE reductions) are exempt and reported under
+    ``census["other"]``.
+
+    Returns ``{hazard, census, activation_psums, per_layer, findings}``.
+    Counts are CALL SITES per trace, like the comm accounting
+    (monitor/comms.py): a body inside ``lax.scan`` counts once, not once
+    per layer. ``per_layer`` divides the activation counts by
+    ``num_layers`` when given -- only meaningful when the trace unrolls
+    the layers (``unroll_layers=True``) or ``fn`` IS a single layer body
+    with ``num_layers`` omitted (the "all-reduce count per layer 2 -> 0"
+    evidence number, benchmarks/overlap_evidence.py).
+    """
+    import jax
+
+    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
+        jaxpr = fn.jaxpr
+    else:
+        env = list(axes.items()) if axes else None
+        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    census = tp_collective_census(jaxpr, tp_axis,
+                                  min_activation_rank=min_activation_rank)
+    n_psum = sum(n for verb, n in census["activation"].items()
+                 if verb in ("psum", "pmean"))
+    findings = []
+    if n_psum:
+        findings.append({
+            "rule": "sp-regression",
+            "message": (
+                f"forward jaxpr carries {n_psum} psum/pmean of "
+                f"activation-shaped operands on the '{tp_axis}' axis -- a "
+                f"sequence-parallel region regressed to a synchronous "
+                f"all-reduce; route it through the psum_scatter/all_gather "
+                f"conjugates (tensor_parallel/mappings.py table 2)"),
+            "verb": "psum", "extra": n_psum,
+        })
+    out = {
+        "hazard": bool(n_psum),
+        "census": census,
+        "activation_psums": n_psum,
+        "findings": findings,
+    }
+    if num_layers:
+        out["per_layer"] = {
+            verb: round(n / num_layers, 3)
+            for verb, n in census["activation"].items()}
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -136,6 +136,27 @@ class TransformerBase:
         self.cfg = c = config
         if c.hidden_size % c.num_attention_heads:
             raise ValueError("hidden_size must divide evenly into heads")
+        # Megatron-style sequence parallelism over the TP axis
+        # (cfg.sequence_parallel): the row-parallel forward psums decompose
+        # into psum_scatter + a later pre-GEMM all-gather, and everything
+        # between them (LN, dropout, residual) runs on (b, s/tp, h) shards.
+        # Serial (axis=None) ignores the knob — one code path.
+        self._sp = bool(getattr(c, "sequence_parallel", False)) and c.axis is not None
+        if self._sp:
+            # seq % tp == 0 is a runtime property (the axis size lives in
+            # the mesh), but when the mesh is already up we can fail HERE
+            # with the knob named, instead of deep inside the embedding's
+            # reduce-scatter with a bare divisibility error
+            from apex_tpu.parallel import mesh as mesh_lib
+
+            if mesh_lib.model_parallel_is_initialized():
+                tp_size = mesh_lib.get_tensor_model_parallel_world_size()
+                if tp_size > 1 and c.max_seq_len % tp_size:
+                    raise ValueError(
+                        f"sequence_parallel=True needs max_seq_len "
+                        f"({c.max_seq_len}) divisible by the tensor-"
+                        f"parallel size ({tp_size}): the embedding "
+                        f"reduce-scatter shards the sequence tp ways")
         init = tp.scaled_normal(c.init_method_std)
         # Megatron scales output-layer init by 1/sqrt(2L)
         # (standalone_gpt.py scaled_init_method_normal).
@@ -143,22 +164,27 @@ class TransformerBase:
         self._init = init
         self.embedding = tp.VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, axis=c.axis,
+            sequence_parallel=self._sp,
             params_dtype=c.params_dtype, init_method=init,
         )
         self.qkv = tp.ColumnParallelLinear(
             c.hidden_size, 3 * c.hidden_size, axis=c.axis, gather_output=False,
+            sequence_parallel=self._sp,
             params_dtype=c.params_dtype, init_method=init,
         )
         self.proj = tp.RowParallelLinear(
             c.hidden_size, c.hidden_size, axis=c.axis, input_is_parallel=True,
+            sequence_parallel=self._sp,
             params_dtype=c.params_dtype, init_method=out_init,
         )
         self.fc1 = tp.ColumnParallelLinear(
             c.hidden_size, c.ffn, axis=c.axis, gather_output=False,
+            sequence_parallel=self._sp,
             params_dtype=c.params_dtype, init_method=init,
         )
         self.fc2 = tp.RowParallelLinear(
             c.ffn, c.hidden_size, axis=c.axis, input_is_parallel=True,
+            sequence_parallel=self._sp,
             params_dtype=c.params_dtype, init_method=out_init,
         )
 
@@ -208,10 +234,30 @@ class TransformerBase:
 
     # -- compute helpers ----------------------------------------------------
 
-    def _ln(self, p: Params, x: jax.Array) -> jax.Array:
+    def _sp_param(self, x: jax.Array) -> jax.Array:
+        """A REPLICATED parameter about to be consumed in a sequence-sharded
+        region: each TP rank sees only its tokens, so AD alone would leave a
+        PARTIAL per-rank gradient — and the harnesses' spec-aware reduction
+        (allreduce_gradients_by_spec) never psums over the model axis for
+        replicated params. The identity-forward/psum-backward ``copy_to``
+        restores the plain-TP convention (full, identical grads on every TP
+        rank) inside the differentiated function — the in-AD form of
+        Megatron's sequence-parallel grad all-reduce."""
+        if not self._sp:
+            return x
+        return tp.copy_to_tensor_model_parallel_region(x, self.cfg.axis)
+
+    def _ln(self, p: Params, x: jax.Array,
+            sequence_region: Optional[bool] = None) -> jax.Array:
         # Mixed-dtype fused LN: bf16 activations, fp32 γβ
-        # (MixedFusedLayerNorm, fused_layer_norm.py:398-436).
-        return fused_layer_norm_op(x, p["scale"], p["bias"])
+        # (MixedFusedLayerNorm, fused_layer_norm.py:398-436). LNs sit in the
+        # sequence-sharded region under sequence parallelism (that sharding
+        # is the mode's memory win), so γβ ride _sp_param by default; head
+        # LNs past the sequence gather pass sequence_region=False.
+        scale, bias = p["scale"], p["bias"]
+        if sequence_region is None or sequence_region:
+            scale, bias = self._sp_param(scale), self._sp_param(bias)
+        return fused_layer_norm_op(x, scale, bias)
 
     def _dense(self, p: Params, x: jax.Array) -> jax.Array:
         return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
@@ -220,17 +266,28 @@ class TransformerBase:
         c = self.cfg
         if key is None or c.hidden_dropout == 0.0:
             return x
-        if rank_unique and c.axis is not None:
+        if self._sp:
+            # sequence-sharded region: every hidden-dropout site in the
+            # model zoo sits between a reduce-scatter and the next gather,
+            # so each TP rank holds DIFFERENT tokens — fold the rank in
+            # (tensor_parallel/random.py sequence_parallel_key) or the
+            # shards would draw correlated masks
+            key = tp.sequence_parallel_key(key, c.axis)
+        elif rank_unique and c.axis is not None:
             key = tp.model_parallel_key(key, c.axis)
         return inverted_dropout(x, key, c.hidden_dropout)
 
     def _attention(self, p: Params, h: jax.Array, bias=None) -> jax.Array:
         c = self.cfg
-        b, s, _ = h.shape
+        b = h.shape[0]
         # named scope = the per-op attribution key of pyprof.report (the
         # NVTX range the reference's nvmarker.py pushes around each module)
         with jax.named_scope("attention"):
             qkv = self.qkv.apply(p["qkv"], h)  # (b, s, 3*H/tp)
+            # under sequence parallelism h arrives (b, s/tp, H) and the
+            # column layer's pre-GEMM all-gather restores the full
+            # (context-local) sequence — read s from the GATHERED tensor
+            s = qkv.shape[1]
             # (heads, 3, head_dim) layout: a TP shard holds whole heads — the
             # layout contract of ParallelAttention (standalone_gpt.py:560-640).
             n_local = qkv.shape[-1] // (3 * c.head_dim)
@@ -245,19 +302,44 @@ class TransformerBase:
             attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
             return self.proj.apply(p["proj"], attn)
 
-    def _positions(self, pos_table: jax.Array, s_local: int) -> jax.Array:
-        """Slice the learned position table for this shard's tokens. Under
-        sequence parallelism (``context_axis`` set) each shard's global
-        positions start at ``rank * local_seq``."""
-        ctx = getattr(self.cfg, "context_axis", None)
+    def _seq_shard_start(self, s_local: int):
+        """Global position of this shard's first token for a tensor whose
+        sequence dim is ``s_local`` long: the context-parallel offset
+        (tokens arrive pre-sliced over ``context_axis``) plus the
+        sequence-parallel offset (the embedding's reduce-scatter slices the
+        context-local sequence a further tp ways). Returns a static 0 when
+        neither axis shards the sequence."""
+        c = self.cfg
+        ctx = getattr(c, "context_axis", None)
+        start = 0
         if ctx is not None:
-            start = lax.axis_index(ctx) * s_local
-            return lax.dynamic_slice_in_dim(pos_table, start, s_local, axis=0)
-        return pos_table[:s_local]
+            cp_local = s_local * (lax.axis_size(c.axis) if self._sp else 1)
+            start = lax.axis_index(ctx) * cp_local
+        if self._sp:
+            start = start + lax.axis_index(c.axis) * s_local
+        return start
+
+    def _positions(self, pos_table: jax.Array, s_local: int) -> jax.Array:
+        """Slice the learned position table for this shard's tokens —
+        ``s_local`` is the LOCAL sequence length of the activation the
+        positions are added to (context- and/or sequence-parallel-sharded);
+        global positions start at :meth:`_seq_shard_start`. The table is a
+        replicated param consumed per-shard, so under sequence parallelism
+        it rides :meth:`_sp_param` for the grad bookkeeping (the
+        context-axis slice needs no such wrap: the harness's pmean over the
+        gradient-reduction axes recovers disjoint-row sums exactly)."""
+        pos_table = self._sp_param(pos_table)
+        ctx = getattr(self.cfg, "context_axis", None)
+        if ctx is None and not self._sp:
+            return pos_table[:s_local]
+        return lax.dynamic_slice_in_dim(
+            pos_table, self._seq_shard_start(s_local), s_local, axis=0)
 
     def _token_positions(self, s_local: int) -> jax.Array:
-        """GLOBAL positions of this shard's tokens (for rotary embedding):
-        the same shard-offset contract as :meth:`_positions`."""
+        """GLOBAL positions of this shard's tokens (for rotary embedding).
+        Called on the GATHERED sequence inside attention, where only the
+        context axis still shards the sequence — the sequence-parallel
+        offset never applies here."""
         ctx = getattr(self.cfg, "context_axis", None)
         start = lax.axis_index(ctx) * s_local if ctx is not None else 0
         return start + jnp.arange(s_local, dtype=jnp.int32)

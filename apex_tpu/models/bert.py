@@ -52,6 +52,12 @@ class BertConfig:
     type_vocab_size: int = 2
     ffn_hidden_size: Optional[int] = None
     axis: Optional[str] = AXIS_MODEL
+    # Megatron-style sequence parallelism on the TP axis (see
+    # GPTConfig.sequence_parallel): decomposed TP collectives +
+    # sequence-sharded LN/dropout/residual regions; the MLM head gathers
+    # the sequence back at entry (the [CLS] pooler and the tied decode see
+    # the full sequence). Ignored when axis is None.
+    sequence_parallel: bool = False
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     hidden_dropout: float = 0.1
@@ -163,9 +169,18 @@ class BertModel(TransformerBase):
         c = self.cfg
         with jax.named_scope("embed"):
             h = self.embedding.apply(params["embedding"], tokens)
-            h = h + self._positions(params["position"], tokens.shape[-1])
+            # h.shape[1] is the sequence-parallel shard length under SP
+            # (the embedding reduce-scattered); positions/tokentypes add
+            # after the closing collective, never to the partial sums
+            h = h + self._positions(params["position"], h.shape[1])
             if tokentype_ids is not None:
-                h = h + jnp.take(params["tokentype"], tokentype_ids, axis=0)
+                if self._sp:
+                    s_local = h.shape[1]
+                    tokentype_ids = lax.dynamic_slice_in_dim(
+                        tokentype_ids, lax.axis_index(c.axis) * s_local,
+                        s_local, axis=1)
+                h = h + jnp.take(self._sp_param(params["tokentype"]),
+                                 tokentype_ids, axis=0)
             h = self._ln(params["ln_emb"], h.astype(c.compute_dtype))
             return self._dropout(h, dropout_key).astype(c.compute_dtype)
 
@@ -186,6 +201,17 @@ class BertModel(TransformerBase):
         CE (post_language_model_processing, standalone_bert.py:76-98)."""
         c = self.cfg
         with jax.named_scope("head"):
+            if self._sp:
+                # close the sequence-sharded region before anything reads
+                # global positions (the [CLS] pooler) or the tied decode.
+                # Everything downstream — lm_dense, lm_ln, the copy_to'd
+                # decode, the CE psums — is REPLICATED across TP ranks, so
+                # the gather's adjoint is a plain slice of the replicated
+                # cotangent (tensor_parallel_output_grad=False); a
+                # reduce-scatter there would double-count what copy_to's
+                # backward psum already summed.
+                h = tp.gather_from_sequence_parallel_region(
+                    h, c.axis, False)
             binary_logits = None
             if c.add_binary_head:
                 cls = h[:, 0]
@@ -207,7 +233,8 @@ class BertModel(TransformerBase):
                 binary_logits = self._dense(params["binary_head"],
                                             pooled.astype(jnp.float32))
             g = jax.nn.gelu(self._dense(params["lm_dense"], h))
-            g = self._ln(params["lm_ln"], g)
+            # past the head gather: replicated region, no γβ grad wrap
+            g = self._ln(params["lm_ln"], g, sequence_region=False)
             if c.axis is not None:
                 g = tp.copy_to_tensor_model_parallel_region(g, c.axis)
             wte = params["embedding"]["embedding"].astype(g.dtype)  # (V/tp, H)

@@ -55,6 +55,16 @@ class GPTConfig:
     max_seq_len: int = 1024
     ffn_hidden_size: Optional[int] = None  # default 4*hidden
     axis: Optional[str] = AXIS_MODEL  # tensor-parallel mesh axis (None=serial)
+    # Megatron-style sequence parallelism ON THE TP AXIS (distinct from
+    # context_axis/sequence_parallel_impl below, which shard attention
+    # itself): each layer's two forward TP all-reduces decompose into
+    # psum_scatter + all_gather conjugates and the LN/dropout/residual
+    # regions run sequence-sharded (b, s/tp, h) — 1/tp the activation
+    # bytes there, and two schedulable collectives instead of one
+    # synchronous all-reduce (VERDICT r5: all 9 TP all-reduces compiled
+    # synchronous). Ignored when axis is None; requires max_seq_len
+    # divisible by tp. No reference analog (apex predates Megatron SP).
+    sequence_parallel: bool = False
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     hidden_dropout: float = 0.1
@@ -142,6 +152,11 @@ class GPTModel(TransformerBase):
         if c.position_embedding == "rope" and c.head_dim % 2:
             raise ValueError(
                 f"rope needs an even head_dim, got {c.head_dim}")
+        if c.sequence_parallel and c.moe_num_experts is not None:
+            raise ValueError(
+                "sequence_parallel does not compose with MoE FFNs yet: the "
+                "router must see gathered tokens (the dense fc1/fc2 gather/"
+                "reduce-scatter pair has no MoE counterpart here)")
         if c.moe_num_experts is not None:
             from apex_tpu.transformer.moe import MoEMLP
 
@@ -215,7 +230,11 @@ class GPTModel(TransformerBase):
         with jax.named_scope("embed"):
             h = self.embedding.apply(params["embedding"], tokens)
             if c.position_embedding == "learned":
-                h = h + self._positions(params["position"], tokens.shape[-1])
+                # positions add AFTER the embedding's closing collective
+                # (h.shape[1] is the sequence-parallel shard under SP):
+                # adding them to the pre-reduce partial sums would count
+                # them once per TP rank through the psum/psum_scatter
+                h = h + self._positions(params["position"], h.shape[1])
             # "rope": positions enter at the q/k rotation in _attention;
             # "none": no positional signal at the embedding
             return h.astype(c.compute_dtype)
@@ -267,7 +286,14 @@ class GPTModel(TransformerBase):
                     c.lm_head_chunks)
             wte = params["embedding"]["embedding"].astype(h.dtype)  # (V/tp, H)
             if c.axis is not None:
-                h = tp.copy_to_tensor_model_parallel_region(h, c.axis)
+                if c.sequence_parallel:
+                    # close the sequence-sharded region: all-gather forward;
+                    # the backward reduce-scatter sums the per-vocab-shard
+                    # partial cotangents AND re-shards the sequence — the
+                    # copy_to psum and the scatter in one conjugate
+                    h = tp.gather_from_sequence_parallel_region(h, c.axis)
+                else:
+                    h = tp.copy_to_tensor_model_parallel_region(h, c.axis)
             logits = jnp.einsum("bsh,vh->bsv", h, wte)  # vocab-sharded logits
             if targets is None:
                 return logits

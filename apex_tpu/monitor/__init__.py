@@ -59,6 +59,8 @@ from apex_tpu.monitor.hbm import (  # noqa: F401
     HBMMonitor,
     lane_padded_bytes,
     live_array_stats,
+    sequence_parallel_activation_report,
+    sequence_region_layer_bytes,
 )
 from apex_tpu.monitor.journal import (  # noqa: F401
     JournalRecords,

@@ -81,6 +81,92 @@ def live_array_stats(platform: Optional[str] = None) -> Dict[str, Any]:
             "largest_bytes": largest}
 
 
+# ---------------------------------------------------------------------------
+# Sequence-parallel activation accounting: the tp-x memory claim as a number
+# ---------------------------------------------------------------------------
+
+#: the (b, s, h)-shaped tensors a transformer layer materializes OUTSIDE the
+#: TP GEMM regions — between a row-parallel reduce (psum or psum_scatter)
+#: and the next column-parallel entry. These are exactly the tensors
+#: sequence parallelism shrinks by tp: under plain TP they are replicated
+#: full-sequence on every TP rank; under ``sequence_parallel=True`` each
+#: rank holds its (b, s/tp, h) shard (models/_transformer.py regions).
+SEQUENCE_REGION_SITES = (
+    "ln1_out",          # LN before attention (input to the qkv column GEMM)
+    "attn_dropout_out",  # post-attention dropout output
+    "residual1",        # first residual sum
+    "ln2_out",          # LN before the MLP
+    "mlp_dropout_out",  # post-MLP dropout output
+    "residual2",        # second residual sum (the layer's carry)
+)
+
+
+def sequence_region_layer_bytes(
+    batch: int,
+    seq: int,
+    hidden: int,
+    *,
+    tp: int = 1,
+    sequence_parallel: bool = False,
+    itemsize: int = 2,
+    padded: bool = True,
+) -> Dict[str, Any]:
+    """Per-layer bytes of the sequence-region activations on ONE TP rank.
+
+    ``sequence_parallel=True`` divides the sequence dim by ``tp`` (the
+    reduce-scatter shard); ``padded`` applies :func:`lane_padded_bytes`
+    (the T(8,128) layout these tensors occupy when resident). A trace-time
+    ESTIMATE of the shape algebra, not a measurement — remat/fusion decide
+    which sites are simultaneously live, but every site shrinks by the same
+    factor, so the plain/SP ratio is exact.
+    """
+    s_local = seq // tp if (sequence_parallel and tp > 1) else seq
+    shape = (batch, s_local, hidden)
+    per_site = (lane_padded_bytes(shape, itemsize) if padded
+                else batch * s_local * hidden * itemsize)
+    return {
+        "shape": list(shape),
+        "seq_local": s_local,
+        "per_site_bytes": per_site,
+        "sites": list(SEQUENCE_REGION_SITES),
+        "layer_bytes": per_site * len(SEQUENCE_REGION_SITES),
+    }
+
+
+def sequence_parallel_activation_report(
+    batch: int,
+    seq: int,
+    hidden: int,
+    num_layers: int,
+    tp: int,
+    *,
+    itemsize: int = 2,
+) -> Dict[str, Any]:
+    """Plain-TP vs sequence-parallel per-layer activation bytes, per rank.
+
+    The evidence artifact behind the "every activation in the non-TP
+    regions shrinks by tp" claim (benchmarks/overlap_evidence.py,
+    PERF_NOTES.md): same shape algebra as the layer regions, reported as
+    numbers rather than prose."""
+    plain = sequence_region_layer_bytes(
+        batch, seq, hidden, tp=tp, sequence_parallel=False,
+        itemsize=itemsize)
+    sp = sequence_region_layer_bytes(
+        batch, seq, hidden, tp=tp, sequence_parallel=True, itemsize=itemsize)
+    return {
+        "batch": batch, "seq": seq, "hidden": hidden,
+        "num_layers": num_layers, "tp": tp, "itemsize": itemsize,
+        "sites_per_layer": len(SEQUENCE_REGION_SITES),
+        "plain_per_layer_bytes": plain["layer_bytes"],
+        "sp_per_layer_bytes": sp["layer_bytes"],
+        "plain_total_bytes": plain["layer_bytes"] * num_layers,
+        "sp_total_bytes": sp["layer_bytes"] * num_layers,
+        "savings_bytes_per_layer":
+            plain["layer_bytes"] - sp["layer_bytes"],
+        "ratio": round(plain["layer_bytes"] / max(sp["layer_bytes"], 1), 3),
+    }
+
+
 class HBMMonitor:
     """Sampling monitor over :func:`live_array_stats`.
 

@@ -306,6 +306,24 @@ def _check_lint() -> dict:
         {"scale": 2.0, "x": jnp.ones((2,), jnp.float32)},
         weak=jnp.asarray(1.0))
     assert sorted(h["kind"] for h in haz) == ["python-scalar", "weak-type"], haz
+
+    # engine 2, sequence-parallel tripwire: an activation psum on the TP
+    # axis is the regression; the reduce_scatter/all_gather conjugates and
+    # CE-shaped rank-2 psums pass
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        gather_from_sequence_parallel_region,
+        reduce_scatter_to_sequence_parallel_region)
+
+    act = jnp.ones((2, 8, 4), jnp.float32)
+    sp_bad = lint_trace.sequence_parallel_hazards(
+        lambda a: lax.psum(a, "model") * 2.0, act, axes={"model": 4})
+    assert sp_bad["hazard"] and sp_bad["activation_psums"] == 1, sp_bad
+    sp_ok = lint_trace.sequence_parallel_hazards(
+        lambda a: gather_from_sequence_parallel_region(
+            reduce_scatter_to_sequence_parallel_region(a, "model"), "model"),
+        act, axes={"model": 4})
+    assert not sp_ok["hazard"], sp_ok
+    assert sp_ok["census"]["activation"].get("reduce_scatter") == 1, sp_ok
     return {"ok": True, "files": rep.files_scanned,
             "suppressed": len(rep.suppressed),
             "padding_waste_bytes": pad["waste_bytes"]}

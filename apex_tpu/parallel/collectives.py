@@ -47,7 +47,23 @@ AxisNames = Union[str, Tuple[str, ...]]
 COMM_SCOPE_PRIMS = {"psum", "pmean", "pmax", "pmin", "all_gather",
                     "psum_scatter", "ppermute", "all_to_all", "pshuffle",
                     "all_gather_invariant"}
-COMM_SCOPE_HELPERS = ("_comm", "collective_scope")
+# Call names that satisfy the comm:-scope contract: the scope helpers
+# themselves, plus the conjugate sequence-parallel mappings
+# (tensor_parallel/mappings.py) whose forward AND custom-VJP backward each
+# run under their own comm: scope — a composite verb built on them needs no
+# re-scoping.
+COMM_SCOPE_HELPERS = ("_comm", "collective_scope",
+                      "scatter_to_sequence_parallel_region",
+                      "gather_from_sequence_parallel_region",
+                      "reduce_scatter_to_sequence_parallel_region")
+
+# The jaxpr-level decomposition contract of sequence parallelism (read
+# statically by apex_tpu.lint.trace.sequence_parallel_hazards, like the
+# comm-scope sets above): in a sequence-parallel forward trace, activation
+# traffic on the TP axis must appear ONLY as these primitives — a bare
+# ``psum`` of an activation there means the psum_scatter/all_gather
+# decomposition silently regressed to a synchronous all-reduce.
+SEQUENCE_PARALLEL_DECOMPOSED_PRIMS = ("reduce_scatter", "all_gather")
 
 #: every verb in this module must run under a ``comm:`` scope; the marker
 #: opts the file into the lint rule even if the import shape changes

@@ -12,8 +12,11 @@ from apex_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
 )
 from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
     copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
     gather_from_tensor_model_parallel_region,
     reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
@@ -25,6 +28,7 @@ from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
     checkpoint_policies,
     data_parallel_key,
     model_parallel_key,
+    sequence_parallel_key,
 )
 from apex_tpu.transformer.tensor_parallel.data import broadcast_data  # noqa: F401
 from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401

@@ -79,6 +79,14 @@ class ColumnParallelLinear:
 
     forward: x → copy_to_region (identity fwd / psum bwd) → local GEMM
     → optional all-gather of outputs (``gather_output``, layers.py:348-356).
+
+    ``sequence_parallel=True`` (Megatron-style sequence parallelism; no
+    reference analog — apex/transformer predates it): the input arrives
+    SEQUENCE-SHARDED ``(b, s/tp, in)`` and the pre-GEMM collective becomes
+    an all-gather of the sequence dim (backward: reduce-scatter of the
+    partial input cotangents) instead of the identity/psum ``copy_to`` —
+    mappings.py table 2. Requires ``gather_output=False``: the output stays
+    TP-sharded for the row-parallel conjugate downstream.
     """
 
     in_features: int
@@ -87,8 +95,16 @@ class ColumnParallelLinear:
     gather_output: bool = True
     axis: Optional[str] = AXIS_MODEL
     skip_bias_add: bool = False
+    sequence_parallel: bool = False
     params_dtype: Any = jnp.float32
     init_method: Callable = xavier_normal
+
+    def __post_init__(self):
+        if self.sequence_parallel and self.gather_output:
+            raise ValueError(
+                "sequence_parallel=True requires gather_output=False: the "
+                "sequence-parallel region contract keeps the column output "
+                "TP-sharded for the row-parallel reduce-scatter downstream")
 
     def init(self, key) -> Params:
         wkey, _ = jax.random.split(key)
@@ -110,7 +126,10 @@ class ColumnParallelLinear:
 
     def apply(self, params: Params, x: jax.Array):
         if self.axis is not None:
-            x = mappings.copy_to_tensor_model_parallel_region(x, self.axis)
+            if self.sequence_parallel:
+                x = mappings.gather_from_sequence_parallel_region(x, self.axis)
+            else:
+                x = mappings.copy_to_tensor_model_parallel_region(x, self.axis)
         y = x @ params["kernel"].astype(x.dtype)
         b = params.get("bias")
         if b is not None and not self.skip_bias_add:
@@ -132,6 +151,15 @@ class RowParallelLinear:
     forward: local GEMM on the input shard → psum across the TP axis →
     bias added *after* the reduce (layers.py:470-476), so the replicated bias
     is applied once.
+
+    ``sequence_parallel=True``: the forward psum decomposes into a
+    ``psum_scatter`` of the sequence dim (mappings.py table 2) — the output
+    lands SEQUENCE-SHARDED ``(b, s/tp, out)`` and the LN/dropout/residual
+    region that consumes it holds 1/tp the activation bytes. The replicated
+    bias is then consumed in a sequence-sharded region, so it rides a
+    ``copy_to`` (identity forward, psum backward) to keep its gradient
+    full-and-replicated across TP ranks — the in-AD form of Megatron's
+    sequence-parallel grad all-reduce. Requires ``input_is_parallel``.
     """
 
     in_features: int
@@ -140,8 +168,16 @@ class RowParallelLinear:
     input_is_parallel: bool = True
     axis: Optional[str] = AXIS_MODEL
     skip_bias_add: bool = False
+    sequence_parallel: bool = False
     params_dtype: Any = jnp.float32
     init_method: Callable = xavier_normal
+
+    def __post_init__(self):
+        if self.sequence_parallel and not self.input_is_parallel:
+            raise ValueError(
+                "sequence_parallel=True requires input_is_parallel=True: "
+                "the sequence-parallel region contract feeds the row GEMM "
+                "from an un-gathered column-parallel output")
 
     def init(self, key) -> Params:
         wkey, _ = jax.random.split(key)
@@ -165,8 +201,18 @@ class RowParallelLinear:
             x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis)
         y = x @ params["kernel"].astype(x.dtype)
         if self.axis is not None:
-            y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis)
+            if self.sequence_parallel:
+                y = mappings.reduce_scatter_to_sequence_parallel_region(
+                    y, self.axis)
+            else:
+                y = mappings.reduce_from_tensor_model_parallel_region(
+                    y, self.axis)
         b = params.get("bias")
+        if b is not None and self.axis is not None and self.sequence_parallel:
+            # replicated param consumed by a sequence-sharded output: the
+            # identity-forward/psum-backward copy keeps its grad total and
+            # replicated across TP ranks (class docstring)
+            b = mappings.copy_to_tensor_model_parallel_region(b, self.axis)
         if self.skip_bias_add:
             return y, (b.astype(y.dtype) if b is not None else None)
         if b is not None:
@@ -180,11 +226,18 @@ class VocabParallelEmbedding:
 
     forward: mask ids outside this rank's vocab range, look up locally with
     out-of-range rows zeroed, psum across the TP axis (layers.py:176-203).
+
+    ``sequence_parallel=True``: the closing psum becomes a ``psum_scatter``
+    of the sequence dim — the embedding output enters the first
+    sequence-sharded region directly, ``(b, s/tp, h)`` per rank, and the
+    backward all-gather hands every rank the full-sequence cotangent its
+    local vocab rows need (mappings.py table 2).
     """
 
     num_embeddings: int
     embedding_dim: int
     axis: Optional[str] = AXIS_MODEL
+    sequence_parallel: bool = False
     params_dtype: Any = jnp.float32
     init_method: Callable = xavier_normal
 
@@ -211,6 +264,9 @@ class VocabParallelEmbedding:
         # reduce_from (psum fwd / identity bwd) exactly as the reference ends
         # its embedding forward (layers.py:201) — raw lax.psum would get the
         # conservative shard_map transpose and mis-scale the table gradient.
+        if self.sequence_parallel:
+            return mappings.reduce_scatter_to_sequence_parallel_region(
+                out, self.axis)
         return mappings.reduce_from_tensor_model_parallel_region(out, self.axis)
 
 

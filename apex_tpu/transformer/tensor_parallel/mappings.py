@@ -17,6 +17,26 @@ reduce-scatter) is preserved exactly:
 | reduce_from_...   | psum                | identity            | mappings.py:36 |
 | scatter_to_...    | slice (last dim)    | all-gather          | mappings.py:49 |
 | gather_from_...   | all-gather (last)   | slice (last dim)    | mappings.py:62 |
+
+Sequence-parallel conjugates (Megatron-style sequence parallelism — the
+reference's apex/transformer predates it; Megatron-LM megatron/core/
+tensor_parallel/mappings.py is the semantic source): the tensors move along
+the SEQUENCE dim (dim 1 of ``(b, s, h)`` activations here; Megatron's
+s-major layout uses dim 0), and the row-parallel forward ``psum`` decomposes
+into ``psum_scatter`` + a later ``all_gather`` — same bytes on the wire, but
+two schedulable ops instead of one synchronous all-reduce, and every
+activation between them is 1/tp the size:
+
+| fn                            | forward            | backward             |
+|-------------------------------|--------------------|----------------------|
+| scatter_to_sequence_...       | slice (seq dim)    | all-gather (seq)     |
+| gather_from_sequence_...      | all-gather (seq)   | psum_scatter (seq)*  |
+| reduce_scatter_to_sequence_...| psum_scatter (seq) | all-gather (seq)     |
+
+(*) ``tensor_parallel_output_grad=False`` flips the gather's backward to a
+plain slice — for call sites whose downstream cotangent is already
+REPLICATED across the TP group (e.g. after an identity-forward/psum-backward
+``copy_to``), where a reduce-scatter would over-count by the axis size.
 """
 
 from __future__ import annotations
@@ -121,3 +141,97 @@ def _gather_bwd(axis, _, g):
 
 
 gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel conjugates (module docstring table 2). The sequence dim
+# is dim 1 of (b, s, ...) activations throughout the model zoo.
+# ---------------------------------------------------------------------------
+
+_SEQ_DIM = 1
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis: str = AXIS_MODEL):
+    """Slice this rank's sequence chunk forward, all-gather backward.
+
+    The entry into a sequence-sharded region from a REPLICATED tensor: each
+    shard consumes only its rows, so the assembled (all-gathered) cotangent
+    is the exact total gradient on every rank."""
+    return _local_slice(x, axis, _SEQ_DIM)
+
+
+def _seq_scatter_fwd(x, axis):
+    return _local_slice(x, axis, _SEQ_DIM), None
+
+
+def _seq_scatter_bwd(axis, _, g):
+    with _comm("all_gather", axis, g):
+        return (lax.all_gather(g, axis, axis=_SEQ_DIM, tiled=True),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(
+    x, axis: str = AXIS_MODEL, tensor_parallel_output_grad: bool = True
+):
+    """All-gather the sequence dim forward; backward reduce-scatters.
+
+    The pre-GEMM gather of ``ColumnParallelLinear(sequence_parallel=True)``
+    (and of the sequence-parallel LM head): downstream of the gather each TP
+    rank computes a PARTIAL input cotangent through its own weight shard, so
+    the adjoint both sums over ranks and re-shards the sequence — exactly
+    ``psum_scatter``. Pass ``tensor_parallel_output_grad=False`` when the
+    downstream cotangent is already replicated (a ``copy_to`` psum'd it);
+    the adjoint is then a plain slice."""
+    with _comm("all_gather", axis, x):
+        return lax.all_gather(x, axis, axis=_SEQ_DIM, tiled=True)
+
+
+def _seq_gather_fwd(x, axis, tensor_parallel_output_grad):
+    with _comm("all_gather", axis, x):
+        return lax.all_gather(x, axis, axis=_SEQ_DIM, tiled=True), None
+
+
+def _seq_gather_bwd(axis, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        with _comm("psum_scatter", axis, g):
+            return (lax.psum_scatter(
+                g, axis, scatter_dimension=_SEQ_DIM, tiled=True),)
+    return (_local_slice(g, axis, _SEQ_DIM),)
+
+
+gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis: str = AXIS_MODEL):
+    """psum_scatter the sequence dim forward, all-gather backward.
+
+    Replaces the row-parallel forward ``psum``
+    (:func:`reduce_from_tensor_model_parallel_region`) under sequence
+    parallelism: the partial products are summed AND the result lands
+    sequence-sharded in one collective (same bytes as the all-reduce it
+    decomposes, EQuARX's cost framing), so the LN/dropout/residual region
+    that follows holds 1/tp the activation bytes. The backward all-gather
+    hands every rank the assembled full-sequence cotangent — identical
+    across ranks, preserving the Megatron replicated-downstream convention
+    for the producer's parameters."""
+    with _comm("psum_scatter", axis, x):
+        return lax.psum_scatter(x, axis, scatter_dimension=_SEQ_DIM, tiled=True)
+
+
+def _seq_rs_fwd(x, axis):
+    with _comm("psum_scatter", axis, x):
+        return lax.psum_scatter(
+            x, axis, scatter_dimension=_SEQ_DIM, tiled=True), None
+
+
+def _seq_rs_bwd(axis, _, g):
+    with _comm("all_gather", axis, g):
+        return (lax.all_gather(g, axis, axis=_SEQ_DIM, tiled=True),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_seq_rs_fwd, _seq_rs_bwd)

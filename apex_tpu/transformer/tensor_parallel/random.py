@@ -23,6 +23,10 @@ from apex_tpu.parallel.mesh import AXIS_MODEL
 
 # The reference's model-parallel seed offset (random.py:182: 2718).
 _MODEL_PARALLEL_OFFSET = 2718
+# Sequence-parallel regions get their own offset so SP dropout never
+# collides with model-parallel-rng draws at the same rank (no reference
+# analog: apex predates Megatron sequence parallelism).
+_SEQUENCE_PARALLEL_OFFSET = 1414
 
 
 def model_parallel_key(key: jax.Array, axis: str = AXIS_MODEL) -> jax.Array:
@@ -30,6 +34,19 @@ def model_parallel_key(key: jax.Array, axis: str = AXIS_MODEL) -> jax.Array:
     random.py:174-191). Valid inside shard_map binding ``axis``."""
     return jax.random.fold_in(
         jax.random.fold_in(key, _MODEL_PARALLEL_OFFSET), lax.axis_index(axis)
+    )
+
+
+def sequence_parallel_key(key: jax.Array, axis: str = AXIS_MODEL) -> jax.Array:
+    """A key that differs per TP rank for dropout in SEQUENCE-SHARDED
+    regions (LN/residual/dropout between a row-parallel reduce-scatter and
+    the next column-parallel gather): each rank holds DIFFERENT tokens
+    there, so drawing from the replicated key would correlate masks across
+    the sequence shards. Distinct from :func:`model_parallel_key` — the two
+    region kinds must never share a stream. Valid inside shard_map binding
+    ``axis``."""
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _SEQUENCE_PARALLEL_OFFSET), lax.axis_index(axis)
     )
 
 

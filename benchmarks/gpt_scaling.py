@@ -42,12 +42,16 @@ from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 # the reference grid, gpt_scaling_test.py:52 — extended with one
 # context-parallel config (dp, tp, pp, cp): ring-attention sequence
 # sharding is this framework's beyond-reference axis and belongs in the
-# round-over-round scaling record
-GRID = [(8, 1, 1), (4, 2, 1), (2, 1, 4), (1, 2, 4), (2, 1, 2, 2)]
+# round-over-round scaling record. A 5th "sp" element marks Megatron-style
+# sequence parallelism on the TP axis (GPTConfig.sequence_parallel): the
+# sweep records its comm/static-hazard blocks next to the plain-TP twin so
+# the decomposed-collective structure shows up in scaling_table.json.
+GRID = [(8, 1, 1), (4, 2, 1), (4, 2, 1, 1, "sp"), (2, 1, 4), (1, 2, 4),
+        (2, 1, 2, 2)]
 
 
 def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
-               micro_batch, n_micro, steps):
+               micro_batch, n_micro, steps, sequence_parallel=False):
     n_dev = dp * tp * pp * cp
     if len(jax.devices()) < n_dev:
         return None
@@ -63,6 +67,7 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             num_layers=eff_layers,
             num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
             axis=mesh_lib.AXIS_MODEL if tp > 1 else None,
+            sequence_parallel=sequence_parallel and tp > 1,
             context_axis=mesh_lib.AXIS_CONTEXT if cp > 1 else None,
             compute_dtype=jnp.bfloat16, remat=True,
         )
@@ -133,6 +138,8 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         conf = {"dp": dp, "tp": tp, "pp": pp, "layers": eff_layers}
         if cp > 1:
             conf["cp"] = cp
+        if sequence_parallel and tp > 1:
+            conf["sequence_parallel"] = True
         row = {
             "config": conf,
             "avg_iteration_time_s": round(dt, 4),
@@ -255,11 +262,12 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
     for entry in grid:
         dp, tp, pp = entry[:3]
         cp = entry[3] if len(entry) > 3 else 1
+        sp = len(entry) > 4 and entry[4] == "sp"
         for layers in layers_list:
             res = run_config(
                 dp, tp, pp, cp, hidden=hidden, layers=layers, heads=heads,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
-                n_micro=n_micro, steps=steps)
+                n_micro=n_micro, steps=steps, sequence_parallel=sp)
             if res is None:
                 # not enough devices — no layer count will change that;
                 # record ONE skipped row for this config and move on
@@ -267,17 +275,22 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                        "skipped": "not enough devices"}
                 if cp > 1:
                     res["config"]["cp"] = cp
+                if sp:
+                    res["config"]["sequence_parallel"] = True
                 rows.append(res)
                 print(json.dumps(res), flush=True)
                 break
             res["config"].setdefault("layers", layers)
             eff = res["config"]["layers"]
-            # compare with cp DEFAULTED ON BOTH SIDES: projecting a stored
-            # cp>1 row down to a cp-less key set would make a later plain
-            # config look like its duplicate and silently skip it
+            # compare with cp/sp DEFAULTED ON BOTH SIDES: projecting a
+            # stored cp>1 (or sequence-parallel) row down to a smaller key
+            # set would make a later plain config look like its duplicate
+            # and silently skip it
+            defaults = {"cp": 1, "sequence_parallel": False}
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
-                        "layers": eff}
-            if any({k: r["config"].get(k, 1) for k in base_cfg} == base_cfg
+                        "sequence_parallel": sp and tp > 1, "layers": eff}
+            if any({k: r["config"].get(k, defaults.get(k, 1))
+                    for k in base_cfg} == base_cfg
                    for r in rows):
                 # two requested counts rounded to the same effective config;
                 # don't record the same measurement twice under two labels
@@ -293,6 +306,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             if output_dir:
                 os.makedirs(output_dir, exist_ok=True)
                 cp_tag = f"_cp{cp}" if cp > 1 else ""
+                cp_tag += "_sp" if sp and tp > 1 else ""
                 name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
                 with open(os.path.join(output_dir, name), "w") as f:
                     json.dump(res, f, indent=1)
@@ -301,18 +315,19 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             json.dump({"notes": _TABLE_NOTES, "rows": rows}, f, indent=1)
     # the human-readable table the reference prints as
     # "Average Iteration Time" lines (gpt_scaling_test.py:64-70)
-    hdr = (f"{'dp':>3} {'tp':>3} {'pp':>3} {'cp':>3} {'layers':>6} "
-           f"{'iter_s':>9} {'tok/s':>10}")
+    hdr = (f"{'dp':>3} {'tp':>3} {'pp':>3} {'cp':>3} {'sp':>3} "
+           f"{'layers':>6} {'iter_s':>9} {'tok/s':>10}")
     print(hdr)
     for r in rows:
         c = r["config"]
+        sp_mark = "sp" if c.get("sequence_parallel") else "-"
         if "skipped" in r:
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
-                  f"{c.get('cp', 1):>3} {c.get('layers', '-'):>6} "
-                  f"{'skipped':>9}")
+                  f"{c.get('cp', 1):>3} {sp_mark:>3} "
+                  f"{c.get('layers', '-'):>6} {'skipped':>9}")
         else:
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
-                  f"{c.get('cp', 1):>3} {c['layers']:>6} "
+                  f"{c.get('cp', 1):>3} {sp_mark:>3} {c['layers']:>6} "
                   f"{r['avg_iteration_time_s']:>9.4f} "
                   f"{r['tokens_per_sec']:>10.1f}")
     return rows

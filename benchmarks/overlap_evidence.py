@@ -21,10 +21,23 @@ SPMD pipeline ring + data-parallel gradient reduction), built
 abstractly via ``jax.eval_shape`` (topology devices cannot hold real
 buffers) at a width where latency hiding has compute to hide behind.
 
+Sequence parallelism (r6): ``--sequence-parallel`` AOT-compiles the
+``GPTConfig.sequence_parallel=True`` hybrid step — the per-layer forward
+TP all-reduces decomposed into reduce-scatter/all-gather conjugates. The
+record ALWAYS carries (host-side, no TPU needed) a ``collective_census``
+block — per-layer and full-forward collective counts on the TP axis for
+plain vs sequence-parallel, from ``lint.trace.sequence_parallel_hazards``
+(the "all-reduce count per layer 2 -> 0" number) — and an
+``activation_bytes`` block (``monitor.hbm.
+sequence_parallel_activation_report``: the tp-x sequence-region memory
+claim as bytes). When the TPU compile client is unavailable the census
+still gates: ``ok_basis: "census_only"``.
+
 Run (needs the axon PJRT plugin for the TPU compile client; no chip
 time is used — this is compile-only):
     PYTHONPATH=/root/repo:/root/.axon_site python \
-        benchmarks/overlap_evidence.py --output out/overlap_evidence.json
+        benchmarks/overlap_evidence.py --sequence-parallel \
+        --output out/overlap_evidence_sp.json
 """
 
 from __future__ import annotations
@@ -55,7 +68,7 @@ _COMPUTE_OPS = ("fusion", "convolution", "dot", "custom-call")
 
 
 def build_abstract_step(tp, pp, dp, *, hidden, layers, heads, seq, vocab,
-                        n_micro, mesh):
+                        n_micro, mesh, sequence_parallel=False):
     """The gate's hybrid train-step gradient function + fully-abstract
     sharded args (mirrors __graft_entry__._dryrun_config, but via
     eval_shape: topology devices cannot hold buffers)."""
@@ -72,6 +85,7 @@ def build_abstract_step(tp, pp, dp, *, hidden, layers, heads, seq, vocab,
         vocab_size=vocab, hidden_size=hidden, num_layers=layers,
         num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
         axis=mesh_lib.AXIS_MODEL if tp > 1 else None,
+        sequence_parallel=sequence_parallel and tp > 1,
         compute_dtype=jnp.bfloat16, remat=True)
     model = GPTModel(cfg)
     policy = amp.get_policy("O2")
@@ -169,7 +183,54 @@ def analyse(hlo_text):
     }
 
 
+def collective_census(tp, *, hidden, layers, heads, seq, vocab):
+    """Per-layer and full-forward collective counts on the TP axis, plain
+    vs sequence-parallel — host-side trace only (no compile, no TPU). The
+    per-layer numbers come from tracing ONE layer body directly (a scanned
+    stack would count call sites once regardless of depth:
+    lint.trace.sequence_parallel_hazards docstring)."""
+    from apex_tpu.lint.trace import sequence_parallel_hazards
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.parallel.mesh import AXIS_MODEL
+
+    out = {}
+    toks = jnp.zeros((2, seq), jnp.int32)
+    for label, sp in (("plain", False), ("sequence_parallel", True)):
+        cfg = GPTConfig(
+            vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+            num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
+            axis=AXIS_MODEL, sequence_parallel=sp,
+            compute_dtype=jnp.bfloat16, remat=False)
+        model = GPTModel(cfg)
+        # full (unsharded) shapes under an axis_env binding are fine for
+        # COUNTING: the collectives appear either way, values are unused
+        params = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype),
+            jax.eval_shape(model.init, jax.random.PRNGKey(0)))
+        layer0 = jax.tree.map(lambda x: x[0], params["layers"])
+        h = jnp.zeros((2, seq, hidden), jnp.bfloat16)
+        per_layer = sequence_parallel_hazards(
+            lambda p, hh: model._layer(p, hh, None), layer0, h,
+            tp_axis=AXIS_MODEL, axes={AXIS_MODEL: tp})
+        full = sequence_parallel_hazards(
+            lambda p, t: model.apply(p, t, jnp.roll(t, -1, -1)),
+            params, toks, tp_axis=AXIS_MODEL, axes={AXIS_MODEL: tp})
+        out[label] = {
+            "per_layer_forward": per_layer["census"]["activation"],
+            "per_layer_all_reduce": per_layer["activation_psums"],
+            "full_forward": full["census"]["activation"],
+            "full_forward_all_reduce": full["activation_psums"],
+            "hazard": full["hazard"],
+        }
+    return out
+
+
 def main():
+    # jax<0.5 API renames (shard_map/axis_size): installed only when the
+    # harness RUNS as a program, same as gpt_scaling.py
+    from apex_tpu.utils.compat import ensure_jax_compat
+
+    ensure_jax_compat()
     ap = argparse.ArgumentParser()
     ap.add_argument("--topology", default="v5e:2x4")
     ap.add_argument("--tp", type=int, default=2)
@@ -180,6 +241,9 @@ def main():
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--vocab", type=int, default=2048)
     ap.add_argument("--micro", type=int, default=2)
+    ap.add_argument("--sequence-parallel", action="store_true",
+                    help="AOT-compile the sequence_parallel=True hybrid "
+                         "step (the census block always covers both modes)")
     ap.add_argument("--output", default=None)
     args = ap.parse_args()
 
@@ -189,7 +253,39 @@ def main():
               "topology": args.topology,
               "tp": args.tp, "pp": args.pp,
               "hidden": args.hidden, "layers": args.layers,
-              "seq": args.seq}
+              "seq": args.seq,
+              "sequence_parallel": bool(args.sequence_parallel)}
+
+    # host-side evidence first: it must survive a missing TPU compile client
+    census_ok = False
+    try:
+        census = collective_census(
+            args.tp, hidden=args.hidden, layers=args.layers,
+            heads=args.heads, seq=args.seq, vocab=args.vocab)
+        record["collective_census"] = census
+        census_ok = (census["sequence_parallel"]["per_layer_all_reduce"] == 0
+                     and census["sequence_parallel"]["full_forward_all_reduce"] == 0
+                     and census["plain"]["per_layer_all_reduce"] >= 2)
+        record["census_ok"] = census_ok
+    except Exception as e:  # noqa: BLE001 - census failure is a result too
+        record["census_error"] = str(e)[:300]
+    try:
+        from apex_tpu.monitor.hbm import sequence_parallel_activation_report
+
+        # per-rank batch mirrors build_abstract_step's 2*dp*n_micro with
+        # dp derived from the requested topology ("v5e:2x4" -> 8 devices),
+        # clamped to >= 1 so an over-subscribed tp*pp still reports real
+        # (per-rank) bytes instead of silent zeros
+        m = re.search(r"(\d+)x(\d+)", args.topology)
+        n_top = int(m.group(1)) * int(m.group(2)) if m else args.tp * args.pp
+        dp_guess = max(1, n_top // (args.tp * args.pp))
+        record["activation_bytes"] = sequence_parallel_activation_report(
+            batch=2 * dp_guess * args.micro,
+            seq=args.seq, hidden=args.hidden, num_layers=args.layers,
+            tp=args.tp)
+    except Exception as e:  # noqa: BLE001
+        record["activation_bytes"] = {"error": str(e)[:200]}
+
     try:
         from jax.experimental import topologies
 
@@ -206,18 +302,36 @@ def main():
             shard_fn, abstract_args = build_abstract_step(
                 args.tp, args.pp, dp, hidden=args.hidden,
                 layers=args.layers, heads=args.heads, seq=args.seq,
-                vocab=args.vocab, n_micro=args.micro, mesh=mesh)
+                vocab=args.vocab, n_micro=args.micro, mesh=mesh,
+                sequence_parallel=args.sequence_parallel)
             print("lowering against topology...", file=sys.stderr)
             compiled = jax.jit(shard_fn).lower(*abstract_args).compile()
             txt = compiled.as_text()
             record.update(analyse(txt))
-            record["ok"] = bool(record["async_pairs"] > 0
-                                and record["pairs_with_compute_between"] > 0)
+            # aot_async_ok is the r5 latency-hiding claim. A
+            # --sequence-parallel run's configured claim is the r6
+            # decomposition, which the census gates (async-pair detection
+            # depends on the compile client's scheduling flags: the r5
+            # tunnel run showed 2 ppermute pairs, this container's libtpu
+            # shows 0 for the same program — but the all-reduce COUNT
+            # comparison holds in matched conditions: 9 plain vs 4
+            # sequence-parallel). A PLAIN run keeps the original meaning:
+            # ok iff the async demonstration itself succeeded.
+            aot_ok = bool(record["async_pairs"] > 0
+                          and record["pairs_with_compute_between"] > 0)
+            record["aot_async_ok"] = aot_ok
+            record["ok"] = bool(aot_ok or
+                                (args.sequence_parallel and census_ok))
+            record["ok_basis"] = "aot" if aot_ok else "census"
         finally:
             mesh_lib.destroy_model_parallel()
     except Exception as e:  # noqa: BLE001 - a negative result is a result
         record["error"] = str(e)[:500]
-        record["ok"] = False
+        # no TPU compile client: a sequence-parallel run's decomposition
+        # claim (the thing a refactor can silently regress) still gates on
+        # the host-side census; a plain run has nothing left to show
+        record["ok"] = bool(args.sequence_parallel and census_ok)
+        record["ok_basis"] = "census_only"
 
     print(json.dumps(record))
     if args.output:

@@ -201,3 +201,108 @@ def test_degraded_evidence_handles_missing_baseline(monkeypatch):
     d = frag["gpt_degraded"]
     assert d["tokens_per_sec"] == 50.0
     assert "vs_baseline" not in d and "o0" not in d["spread"]
+
+
+# -- BERT + profile degraded-rung ladders (VERDICT r5 top_next: every
+# flagship config must carry a number with rung provenance, not an errors
+# entry, under simulated co-tenant OOM) ------------------------------------
+
+
+def _oom(msg="RESOURCE_EXHAUSTED: simulated co-tenant occupation"):
+    raise RuntimeError(msg)
+
+
+def test_bert_resilient_flagship_passes_through():
+    """A healthy flagship run gains NO degraded marker."""
+    def measure(batch, steps, windows, hidden=None, layers=None):
+        assert hidden is None and layers is None
+        return dict(_stats_of(9000.0), batch=8, unroll=True)
+
+    rec = bench.bench_bert_resilient(8, 10, 3, measure=measure)
+    assert rec["median"] == 9000.0
+    assert "degraded" not in rec
+
+
+def test_bert_resilient_degrades_with_provenance():
+    """Flagship OOM (even at batch 1) → the 768/12 rung's number is
+    recorded WITH rung provenance including the flagship's OOM message."""
+    calls = []
+
+    def measure(batch, steps, windows, hidden=None, layers=None):
+        calls.append((hidden, layers))
+        if hidden is None:
+            _oom("bert: OOM even at batch 1; last: RESOURCE_EXHAUSTED")
+        return dict(_stats_of(4000.0), batch=4, unroll=True)
+
+    rec = bench.bench_bert_resilient(8, 10, 3, measure=measure)
+    assert calls == [(None, None), (768, 12)]
+    assert rec["median"] == 4000.0
+    assert rec["degraded"]["hidden"] == 768
+    assert rec["degraded"]["layers"] == 12
+    assert "RESOURCE_EXHAUSTED" in rec["degraded"]["flagship_oom"]
+
+
+def test_bert_resilient_exhausted_ladder_raises_oom_marker():
+    def measure(batch, steps, windows, hidden=None, layers=None):
+        _oom()
+
+    with pytest.raises(RuntimeError, match="smallest degraded rung"):
+        bench.bench_bert_resilient(8, 10, 3, measure=measure)
+
+
+def test_bert_resilient_reraises_non_oom():
+    def measure(batch, steps, windows, hidden=None, layers=None):
+        raise ValueError("a real bug, not memory pressure")
+
+    with pytest.raises(ValueError):
+        bench.bench_bert_resilient(8, 10, 3, measure=measure)
+
+
+def test_profile_evidence_degrades_with_provenance(monkeypatch):
+    """The --gpt-profile leg: flagship-shape OOM (the whole internal remat/
+    batch ladder exhausted) → the 768/12 rung's profile is the record, with
+    rung provenance, and the leg reports NO error."""
+    def fake_profile(batch, seq, steps=3, hidden=None, layers=None):
+        if hidden is None:
+            return None, {"pyprof_345m": "RESOURCE_EXHAUSTED: hbm"}
+        return {"model": f"gpt_h{hidden}_L{layers}", "batch": batch,
+                "seq": seq, "total_ms": 42.0}, {}
+
+    monkeypatch.setattr(bench, "_profile_345m", fake_profile)
+    frag, errs = bench._gpt_profile_evidence(8, 1024, 10)
+    assert errs == {}
+    prof = frag["pyprof_scope_seconds"]
+    assert prof["total_ms"] == 42.0
+    assert prof["degraded"]["hidden"] == 768
+    assert "RESOURCE_EXHAUSTED" in prof["degraded"]["flagship_oom"]
+
+
+def test_profile_evidence_flagship_passes_through(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_profile_345m",
+        lambda batch, seq, steps=3, hidden=None, layers=None: (
+            {"model": "gpt2_345m", "total_ms": 260.0}, {}))
+    frag, errs = bench._gpt_profile_evidence(8, 1024, 10)
+    assert errs == {}
+    assert frag["pyprof_scope_seconds"]["total_ms"] == 260.0
+    assert "degraded" not in frag["pyprof_scope_seconds"]
+
+
+def test_profile_evidence_all_rungs_oom(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_profile_345m",
+        lambda batch, seq, steps=3, hidden=None, layers=None: (
+            None, {"pyprof_345m": "RESOURCE_EXHAUSTED: hbm"}))
+    frag, errs = bench._gpt_profile_evidence(8, 1024, 10)
+    assert frag == {}
+    assert "OOM at every profile rung" in errs["pyprof_345m"]
+
+
+def test_profile_evidence_non_tpu_noop(monkeypatch):
+    """Off-TPU the profile returns (None, {}) — no degradation loop, no
+    error entry."""
+    monkeypatch.setattr(
+        bench, "_profile_345m",
+        lambda batch, seq, steps=3, hidden=None, layers=None: (None, {}))
+    frag, errs = bench._gpt_profile_evidence(8, 1024, 10)
+    assert frag == {} and errs == {}

@@ -436,6 +436,80 @@ def test_transpose_hazard_ignores_nonscalar_collectives():
 
 
 # ---------------------------------------------------------------------------
+# engine 2: sequence-parallel decomposition tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_parallel_hazard_flags_activation_psum():
+    def regressed(x):
+        y = lax.psum(x, "model")  # (b, s, h) all-reduce: the regression
+        return y * 2.0
+
+    hz = trace.sequence_parallel_hazards(
+        regressed, jnp.ones((2, 8, 4)), axes={"model": 4})
+    assert hz["hazard"] and hz["activation_psums"] == 1
+    assert hz["findings"][0]["rule"] == "sp-regression"
+    assert "psum_scatter/all_gather" in hz["findings"][0]["message"]
+
+
+def test_sequence_parallel_hazard_passes_decomposed_and_scalar():
+    """The decomposed conjugates (reduce_scatter/all_gather) and the
+    scalar/rank-2 psums of the vocab-parallel CE are NOT hazards — and the
+    census reports them under their buckets."""
+    from apex_tpu.parallel.collectives import (
+        SEQUENCE_PARALLEL_DECOMPOSED_PRIMS)
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    def decomposed(x):
+        y = mappings.reduce_scatter_to_sequence_parallel_region(x, "model")
+        y = mappings.gather_from_sequence_parallel_region(y, "model")
+        loss2d = lax.psum(jnp.sum(y, -1), "model")  # (b, s): CE-shaped
+        return loss2d
+
+    hz = trace.sequence_parallel_hazards(
+        decomposed, jnp.ones((2, 8, 4)), axes={"model": 4})
+    assert not hz["hazard"], hz
+    assert set(hz["census"]["activation"]) == set(
+        SEQUENCE_PARALLEL_DECOMPOSED_PRIMS)
+    assert hz["census"]["other"] == {"psum": 1}
+
+
+def test_sequence_parallel_hazard_on_gpt_models():
+    """The model-level regression gate (ISSUE 4 evidence): a
+    sequence-parallel GPT forward jaxpr carries ZERO activation psums on
+    the TP axis (embedding + per-layer all decomposed), while the plain-TP
+    twin shows the all-reduces the mode removes."""
+    import jax
+
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    tiny = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                num_attention_heads=4, max_seq_len=16, hidden_dropout=0.0,
+                compute_dtype=jnp.float32, remat=False)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    counts = {}
+    for sp in (False, True):
+        model = GPTModel(GPTConfig(axis="model", sequence_parallel=sp,
+                                   **tiny))
+        params = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype),
+            jax.eval_shape(model.init, jax.random.PRNGKey(0)))
+        hz = trace.sequence_parallel_hazards(
+            lambda p, t: model.apply(p, t, jnp.roll(t, -1, -1)),
+            params, toks, tp_axis="model", axes={"model": 2})
+        counts[sp] = hz
+    assert counts[True]["activation_psums"] == 0
+    assert not counts[True]["hazard"]
+    # plain TP: embedding psum + the per-layer pair (scanned body counts
+    # call sites once — trace.sequence_parallel_hazards docstring)
+    assert counts[False]["activation_psums"] == 3
+    assert counts[False]["hazard"]
+    # the decomposition is VISIBLE in the SP census, not merely absent
+    assert counts[True]["census"]["activation"].get("reduce_scatter", 0) >= 3
+    assert counts[True]["census"]["activation"].get("all_gather", 0) >= 3
+
+
+# ---------------------------------------------------------------------------
 # engine 2: recompile-hazard scanner
 # ---------------------------------------------------------------------------
 

@@ -108,6 +108,136 @@ def test_gpt_tp_matches_serial():
         mesh_lib.destroy_model_parallel()
 
 
+@pytest.mark.parametrize("pos,unroll", [
+    ("learned", False),
+    # one combined variant keeps the tier-1 wall-clock budget: rope
+    # (positions enter on the GATHERED sequence inside attention — the
+    # sequence-parallel shard offset must NOT leak into them) + unroll
+    # (the gathers/reduce-scatters thread a Python loop body instead of a
+    # scanned one)
+    ("rope", True),
+])
+def test_gpt_sequence_parallel_matches_serial_and_tp(pos, unroll):
+    """ISSUE 4 equivalence gate: serial, plain TP, and sequence-parallel
+    TP share the same modules and must agree on loss AND grads. The SP
+    path swaps every forward TP all-reduce for the reduce-scatter/
+    all-gather conjugates and runs LN/dropout/residual sequence-sharded —
+    including the vocab-parallel embedding scatter and the LM-head gather
+    at the two ends."""
+    cfg = dict(TINY, position_embedding=pos, unroll_layers=unroll)
+    serial = GPTModel(GPTConfig(axis=None, **cfg))
+    seqp = GPTModel(GPTConfig(axis="model", sequence_parallel=True, **cfg))
+    params = serial.init(jax.random.PRNGKey(0))
+    toks, tgt = _data(jax.random.PRNGKey(1))
+
+    # the full 3-way gate runs once (tier-1 wall-clock budget); the rope+
+    # unroll combo pins SP==serial, with SP==plain following transitively
+    # through test_gpt_tp_matches_serial
+    models = [seqp]
+    if (pos, unroll) == ("learned", False):
+        models.insert(0, GPTModel(GPTConfig(axis="model", **cfg)))
+
+    mesh = mesh_lib.make_virtual_mesh(4, tensor_model_parallel_size=4)
+    try:
+        specs = seqp.specs()
+        sharded = tp.shard_params(params, specs, mesh)
+        v_s, g_s = jax.value_and_grad(serial.loss)(params, toks, tgt)
+        for model in models:
+            fn = jax.jit(jax.shard_map(
+                jax.value_and_grad(model.loss), mesh=mesh,
+                in_specs=(specs, P(), P()), out_specs=(P(), specs),
+                check_vma=False))
+            v_p, g_p = fn(sharded, toks, tgt)
+            np.testing.assert_allclose(float(v_s), float(v_p), rtol=2e-5)
+            for a, b in zip(jax.tree.leaves(g_s),
+                            jax.tree.leaves(jax.device_get(g_p))):
+                np.testing.assert_allclose(a, np.asarray(b),
+                                           rtol=2e-4, atol=2e-4)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_gpt_sequence_parallel_with_context_axis_matches_serial():
+    """SP composes with context parallelism: tokens sharded over 'context'
+    (dim 1), each context shard further sequence-sharded over 'model' by
+    the embedding reduce-scatter — the learned-position offsets compose
+    (TransformerBase._seq_shard_start)."""
+    serial = GPTModel(GPTConfig(axis=None, **TINY))
+    par = GPTModel(GPTConfig(
+        axis="model", sequence_parallel=True,
+        context_axis=mesh_lib.AXIS_CONTEXT, **TINY))
+    params = serial.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    tgt = jnp.roll(toks, -1, axis=-1)
+
+    # 4 devices: tp=2 × cp=2 (cp shards of 8 tokens, sp shards of 4)
+    mesh = mesh_lib.make_virtual_mesh(
+        4, tensor_model_parallel_size=2, context_parallel_size=2)
+    try:
+        specs = par.specs()
+        sharded = tp.shard_params(params, specs, mesh)
+
+        def step(p, toks, tgt):
+            loss, g = jax.value_and_grad(par.loss)(p, toks, tgt)
+            return (jax.lax.pmean(loss, mesh_lib.AXIS_CONTEXT),
+                    jax.lax.pmean(g, mesh_lib.AXIS_CONTEXT))
+
+        seq_spec = P(None, mesh_lib.AXIS_CONTEXT)
+        fn = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(specs, seq_spec, seq_spec),
+            out_specs=(P(), specs), check_vma=False))
+        v_p, g_p = fn(sharded, toks, tgt)
+        v_s, g_s = jax.value_and_grad(serial.loss)(params, toks, tgt)
+        np.testing.assert_allclose(float(v_s), float(v_p), rtol=2e-5)
+        for a, b in zip(jax.tree.leaves(g_s),
+                        jax.tree.leaves(jax.device_get(g_p))):
+            np.testing.assert_allclose(a, np.asarray(b), rtol=2e-4, atol=2e-4)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_gpt_sequence_parallel_dropout_deterministic_and_decorrelated():
+    """Rank-offset dropout RNG (tensor_parallel/random.py
+    sequence_parallel_key): same key → same loss (reproducible through
+    remat), different key → different loss, and the SP loss differs from
+    the plain-TP loss at the same key (the per-rank fold actually changes
+    the masks — otherwise the seq shards would reuse one mask pattern)."""
+    cfg = dict(TINY)
+    cfg["hidden_dropout"] = 0.2
+    plain = GPTModel(GPTConfig(axis="model", **cfg))
+    seqp = GPTModel(GPTConfig(axis="model", sequence_parallel=True, **cfg))
+    params = GPTModel(GPTConfig(axis=None, **cfg)).init(jax.random.PRNGKey(0))
+    toks, tgt = _data(jax.random.PRNGKey(1))
+    mesh = mesh_lib.make_virtual_mesh(4, tensor_model_parallel_size=4)
+    try:
+        specs = seqp.specs()
+        sharded = tp.shard_params(params, specs, mesh)
+
+        def runner(model):
+            return jax.jit(jax.shard_map(
+                lambda p, t, g, k: model.loss(p, t, g, dropout_key=k),
+                mesh=mesh, in_specs=(specs, P(), P(), P()), out_specs=P(),
+                check_vma=False))
+
+        k = jax.random.PRNGKey(7)
+        sp_fn, tp_fn = runner(seqp), runner(plain)
+        l1, l2 = float(sp_fn(sharded, toks, tgt, k)), \
+            float(sp_fn(sharded, toks, tgt, k))
+        l3 = float(sp_fn(sharded, toks, tgt, jax.random.PRNGKey(8)))
+        l_tp = float(tp_fn(sharded, toks, tgt, k))
+        assert l1 == l2
+        assert l1 != l3
+        assert l1 != l_tp
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_gpt_sequence_parallel_rejects_moe():
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        GPTModel(GPTConfig(axis="model", sequence_parallel=True,
+                           moe_num_experts=4, **TINY))
+
+
 def test_gpt_trains_serial():
     model = GPTModel(GPTConfig(axis=None, **TINY))
     params = model.init(jax.random.PRNGKey(0))

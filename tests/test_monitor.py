@@ -263,6 +263,67 @@ def test_comm_accounting_by_axis_and_verb():
     assert acct.by_axis()["i"]["calls"] == 2
 
 
+def test_comm_accounting_tallies_sequence_parallel_psum_scatter():
+    """The sequence-parallel conjugates triple the reduce-scatter traffic
+    on the TP axis (ISSUE 4): every ``psum_scatter`` payload must land in
+    the per-axis tally like the psums it replaces — forward AND the
+    custom-VJP backward call sites."""
+    from apex_tpu.transformer import tensor_parallel as tp
+
+    x = jnp.ones((2, 8, 4), jnp.float32)
+    nbytes = 2 * 8 * 4 * 4
+
+    def fwd(x):
+        y = tp.reduce_scatter_to_sequence_parallel_region(x, "model")
+        return tp.gather_from_sequence_parallel_region(y, "model")
+
+    with comm_accounting() as acct:
+        jax.make_jaxpr(fwd, axis_env=[("model", 4)])(x)
+    by_verb = acct.by_verb()
+    assert by_verb["psum_scatter"] == {"bytes": nbytes, "calls": 1}
+    # the gather sees the (2, 2, 4) shard
+    assert by_verb["all_gather"] == {"bytes": nbytes // 4, "calls": 1}
+    assert acct.by_axis()["model"]["calls"] == 2
+
+    # the backward of the gather is ALSO a psum_scatter — attributed to
+    # the same axis through the grad trace
+    def loss(x):
+        y = tp.gather_from_sequence_parallel_region(x, "model")
+        return jnp.sum(y * y)
+
+    with comm_accounting() as acct:
+        jax.make_jaxpr(jax.grad(loss), axis_env=[("model", 4)])(x)
+    assert acct.by_verb()["psum_scatter"]["calls"] == 1
+    assert acct.by_verb()["psum_scatter"]["bytes"] == nbytes * 4  # gathered
+
+
+def test_sequence_parallel_activation_report():
+    """The tp-x memory claim as a number: per-layer sequence-region bytes
+    shrink by exactly tp (both sides use the same lane-padded shape
+    algebra, so the ratio is exact when s/tp keeps the dims tile-aligned)."""
+    from apex_tpu.monitor.hbm import (
+        SEQUENCE_REGION_SITES,
+        sequence_parallel_activation_report,
+        sequence_region_layer_bytes,
+    )
+
+    rep = sequence_parallel_activation_report(
+        batch=8, seq=1024, hidden=1024, num_layers=24, tp=8)
+    assert rep["ratio"] == 8.0
+    assert rep["plain_per_layer_bytes"] == 8 * rep["sp_per_layer_bytes"]
+    assert rep["plain_total_bytes"] == 24 * rep["plain_per_layer_bytes"]
+    assert rep["sites_per_layer"] == len(SEQUENCE_REGION_SITES)
+
+    plain = sequence_region_layer_bytes(8, 1024, 1024, tp=8,
+                                        sequence_parallel=False)
+    sp = sequence_region_layer_bytes(8, 1024, 1024, tp=8,
+                                     sequence_parallel=True)
+    assert plain["seq_local"] == 1024 and sp["seq_local"] == 128
+    # unpadded bf16 site: b*s*h*2 bytes
+    unpadded = sequence_region_layer_bytes(8, 1024, 1024, padded=False)
+    assert unpadded["per_site_bytes"] == 8 * 1024 * 1024 * 2
+
+
 def test_comm_account_reentrancy():
     """Nested accounting contexts both observe every call, nested
     ``collective_scope``s on the SAME axis each tally their own call

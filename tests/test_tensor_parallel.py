@@ -210,6 +210,128 @@ def test_mappings_round_trips(tp_mesh):
     np.testing.assert_allclose(np.asarray(jax.jit(fn)(x)), x, rtol=1e-6)
 
 
+def test_sequence_parallel_mappings_round_trip(tp_mesh):
+    """scatter → gather restores the input; reduce_scatter equals
+    psum-then-slice (the decomposition identity the whole mode rests on)."""
+    x = jax.random.normal(jax.random.PRNGKey(13), (2, 8, 4))
+
+    def round_trip(x):
+        s = tp.scatter_to_sequence_parallel_region(x, "model")
+        assert s.shape == (2, 2, 4)  # seq dim 8 / tp 4
+        return tp.gather_from_sequence_parallel_region(s, "model")
+
+    fn = _shard_map(tp_mesh, round_trip, in_specs=P(), out_specs=P())
+    np.testing.assert_allclose(np.asarray(jax.jit(fn)(x)), x, rtol=1e-6)
+
+    def rs_vs_psum_slice(x):
+        rs = tp.reduce_scatter_to_sequence_parallel_region(x, "model")
+        ref = tp.scatter_to_sequence_parallel_region(
+            tp.reduce_from_tensor_model_parallel_region(x, "model"), "model")
+        return rs - ref
+
+    fn = _shard_map(tp_mesh, rs_vs_psum_slice, in_specs=P(),
+                    out_specs=P(None, "model"))
+    np.testing.assert_allclose(np.asarray(jax.jit(fn)(x)), 0.0, atol=1e-6)
+
+
+def test_sequence_parallel_column_row_sandwich_matches_serial(tp_mesh):
+    """The sequence-parallel Megatron sandwich: seq-sharded x → column
+    (pre-GEMM gather) → row (reduce-scatter out) → seq-sharded y. One
+    all-gather + one psum_scatter forward, and loss/grads must equal the
+    serial model — including the row bias, whose replicated grad rides the
+    copy_to wrap (layers.py docstring)."""
+    key = jax.random.PRNGKey(14)
+    s_up = tp.ColumnParallelLinear(16, 64, axis=None)
+    s_dn = tp.RowParallelLinear(64, 16, axis=None)
+    p_up = tp.ColumnParallelLinear(16, 64, axis="model", gather_output=False,
+                                   sequence_parallel=True)
+    p_dn = tp.RowParallelLinear(64, 16, axis="model", input_is_parallel=True,
+                                sequence_parallel=True)
+    params = {"up": s_up.init(key), "dn": s_dn.init(jax.random.fold_in(key, 1))}
+    specs = {"up": p_up.specs(), "dn": p_dn.specs()}
+    x = jax.random.normal(jax.random.PRNGKey(15), (2, 8, 16))  # (b, s, h)
+
+    def serial_loss(p, x):
+        h = jax.nn.gelu(s_up.apply(p["up"], x))
+        return jnp.mean(s_dn.apply(p["dn"], h) ** 2)
+
+    def par_loss(p, x):
+        h = jax.nn.gelu(p_up.apply(p["up"], x))
+        y = p_dn.apply(p["dn"], h)  # sequence-sharded (b, s/tp, 16)
+        # close the region like the model heads do: gather the sequence
+        # back. The mean-of-squares downstream is rank-independent, so the
+        # cotangent at the gather is REPLICATED — slice-adjoint mode
+        # (tensor_parallel_output_grad=False), not reduce-scatter.
+        y = tp.gather_from_sequence_parallel_region(y, "model", False)
+        return jnp.mean(y ** 2)
+
+    sharded = tp.shard_params(params, specs, tp_mesh)
+    # x arrives SEQUENCE-sharded (dim 1)
+    par_fn = _shard_map(tp_mesh, jax.value_and_grad(par_loss),
+                        in_specs=(specs, P(None, "model")),
+                        out_specs=(P(), specs))
+    v_s, g_s = jax.value_and_grad(serial_loss)(params, x)
+    v_p, g_p = jax.jit(par_fn)(sharded, x)
+    np.testing.assert_allclose(v_s, v_p, rtol=1e-5)
+    flat_s, _ = jax.tree_util.tree_flatten(g_s)
+    flat_p, _ = jax.tree_util.tree_flatten(jax.device_get(g_p))
+    for a, b in zip(flat_s, flat_p):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_gather_from_sequence_parallel_backward_modes(tp_mesh):
+    """The two adjoint conventions: tensor_parallel_output_grad=True
+    reduce-scatters (partial per-rank cotangents sum), False slices (an
+    already-replicated cotangent passes through untouched)."""
+    x = jax.random.normal(jax.random.PRNGKey(16), (2, 8, 4))
+
+    def loss_tp_grad(x):
+        g = tp.gather_from_sequence_parallel_region(x, "model", True)
+        # rank-dependent downstream weight → PARTIAL per-rank cotangents
+        w = (jax.lax.axis_index("model") + 1).astype(x.dtype)
+        return jnp.sum(g * w)
+
+    fn = _shard_map(tp_mesh, jax.grad(loss_tp_grad),
+                    in_specs=P(None, "model"), out_specs=P(None, "model"))
+    g = np.asarray(jax.jit(fn)(x))
+    # every shard's cotangent is sum over ranks of w_k = 1+2+3+4 = 10
+    np.testing.assert_allclose(g, 10.0 * np.ones_like(g), rtol=1e-6)
+
+    def loss_replicated_grad(x):
+        g = tp.gather_from_sequence_parallel_region(x, "model", False)
+        return jnp.sum(g)  # rank-independent → replicated cotangent
+
+    fn = _shard_map(tp_mesh, jax.grad(loss_replicated_grad),
+                    in_specs=P(None, "model"), out_specs=P(None, "model"))
+    g = np.asarray(jax.jit(fn)(x))
+    np.testing.assert_allclose(g, np.ones_like(g), rtol=1e-6)
+
+
+def test_sequence_parallel_layer_flag_validation():
+    with pytest.raises(ValueError, match="gather_output"):
+        tp.ColumnParallelLinear(8, 8, axis="model", gather_output=True,
+                                sequence_parallel=True)
+    with pytest.raises(ValueError, match="input_is_parallel"):
+        tp.RowParallelLinear(8, 8, axis="model", input_is_parallel=False,
+                             sequence_parallel=True)
+
+
+def test_sequence_parallel_key_differs_per_rank_and_stream(tp_mesh):
+    """Rank-offset dropout RNG for sequence-sharded regions: distinct per
+    TP rank AND disjoint from the model-parallel stream at every rank."""
+    def body(key):
+        sp = jax.random.uniform(tp.sequence_parallel_key(key, "model"), (1,))
+        mp = jax.random.uniform(tp.model_parallel_key(key, "model"), (1,))
+        return sp, mp
+
+    fn = _shard_map(tp_mesh, body, in_specs=P(),
+                    out_specs=(P("model"), P("model")))
+    sp, mp = jax.jit(fn)(jax.random.PRNGKey(0))
+    sp, mp = np.asarray(sp), np.asarray(mp)
+    assert len(np.unique(sp)) == TP
+    assert not np.intersect1d(sp, mp).size
+
+
 def test_model_parallel_key_differs_per_rank(tp_mesh):
     def body(key):
         k = tp.model_parallel_key(key, "model")
