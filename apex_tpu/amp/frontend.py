@@ -27,11 +27,13 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from apex_tpu import precision as _precision
 from apex_tpu.amp.scaler import LossScaler
 from apex_tpu.ops.multi_tensor import tree_l2norm, tree_scale
-from apex_tpu.optimizers._common import ClassOptimizer
+from apex_tpu.optimizers._common import ClassOptimizer, sharded_tree_sumsq
 
 
 class MPOptState(NamedTuple):
@@ -41,11 +43,24 @@ class MPOptState(NamedTuple):
     (the ``_amp_stash`` fp32_from_fp16 groups of _process_optimizer.py:28-90);
     otherwise None. ``inner`` is the wrapped transform's state, always built
     over the fp32 view of params. ``scaler`` is the loss-scale state machine.
+
+    Under ``zero_axis`` (the ZeRO path, contrib distributed_fused_adam.py
+    semantics) ``master`` is ALWAYS present and holds this rank's 1-D fp32
+    chunk tree (1/n of every leaf); ``inner`` is built over the chunks, so
+    the whole optimizer footprint is 1/n per rank.
     """
 
     inner: Any
     master: Any
     scaler: LossScaler
+
+
+def _canon_gather_dtype(dt):
+    if dt is None:
+        return None
+    if isinstance(dt, str) and dt.lower() in ("bf16", "bfloat16"):
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(dt)
 
 
 def _scaler_from_policy(policy: _precision.Policy, **scaler_kwargs) -> LossScaler:
@@ -66,6 +81,13 @@ class MixedPrecisionOptimizer:
     4. cast masters back to the model dtypes (multi_tensor_scale copy-out,
        _process_optimizer.py:14-25);
     5. scaler.update(found_inf).
+
+    ``zero_axis`` switches steps 3-4 to the ZeRO path (the first-class
+    spelling of ``optimizers.distributed``'s contrib
+    DistributedFusedAdam/LAMB math): masters + inner state live as 1/n
+    fp32 chunks, the grads arrive UNREDUCED over that axis (psum_scatter
+    performs the reduction), and the updated params come back through a
+    (optionally bf16-compressed) all-gather. See :meth:`zero_init`.
     """
 
     def __init__(
@@ -74,12 +96,33 @@ class MixedPrecisionOptimizer:
         policy: _precision.Policy,
         log_grad_norm: bool = False,
         log_group_norms: bool = False,
+        zero_axis: Optional[str] = None,
+        gather_dtype: Optional[Any] = None,
         **scaler_kwargs,
     ):
         self.inner = (
             optimizer.transform if isinstance(optimizer, ClassOptimizer) else optimizer
         )
         self.policy = policy
+        #: mesh axis the fp32 masters + inner optimizer state are ZeRO-
+        #: sharded over (optimizers/distributed.py math: psum_scatter of
+        #: the UNREDUCED grads is the data-parallel reduction, then a
+        #: sharded inner step over 1/n chunks, then an all-gather of the
+        #: updated params). init/apply_gradients must then run inside
+        #: shard_map binding the axis — see :meth:`zero_init`. Requires
+        #: every param REPLICATED over the axis (dense models; data-sharded
+        #: params like MoE experts cannot be chunked over their own axis).
+        self.zero_axis = zero_axis
+        #: wire dtype of the updated-param all-gather under ``zero_axis``
+        #: (the reference's e5m2-compressed allgather knob,
+        #: distributed_fused_adam.py:64): "bf16" halves the gather bytes.
+        #: fp32 masters stay exact — only the broadcast payload is cast,
+        #: so the params every rank sees are the bf16-rounded view of the
+        #: masters (free under O2, opt-in precision trade elsewhere).
+        self.gather_dtype = _canon_gather_dtype(gather_dtype)
+        if self.gather_dtype is not None and zero_axis is None:
+            raise ValueError("gather_dtype only applies with zero_axis set "
+                             "(it is the ZeRO param-gather wire dtype)")
         #: when True, ``apply_gradients`` metrics include the global L2 norm
         #: of the unscaled grads — the journal hook (monitor/journal.py).
         #: Off by default: the extra tree reduction, while small next to the
@@ -92,9 +135,34 @@ class MixedPrecisionOptimizer:
         #: names the first non-finite layer from the journal alone). Same
         #: opt-in byte-identity contract as ``log_grad_norm``.
         self.log_group_norms = bool(log_group_norms)
+        #: per-leaf tuples of mesh axes each param is SHARDED over (from
+        #: the param_specs seen by ``zero_abstract_state``/``zero_init``):
+        #: the norm metrics psum over ``zero_axis`` plus these, so
+        #: tp/pp-hybrid shards count once and replicated leaves are not
+        #: double-counted. None until the ZeRO wiring runs.
+        self._zero_norm_axes = None
         self._scaler_kwargs = scaler_kwargs
 
     def init(self, model_params) -> MPOptState:
+        if self.zero_axis is not None:
+            # ZeRO: keep only this rank's fp32 chunk of every leaf — the
+            # chunks ARE the masters (exact fp32 regardless of
+            # policy.master_weights: without them the sharded update could
+            # not be applied without re-gathering params first). Must run
+            # inside shard_map binding the axis (zero_init wraps this).
+            from apex_tpu.optimizers.distributed import local_chunk
+
+            n = lax.axis_size(self.zero_axis)
+            idx = lax.axis_index(self.zero_axis)
+            master = jax.tree.map(
+                lambda p: local_chunk(p.astype(jnp.float32), n, idx),
+                model_params,
+            )
+            return MPOptState(
+                inner=self.inner.init(master),
+                master=master,
+                scaler=_scaler_from_policy(self.policy, **self._scaler_kwargs),
+            )
         if self.policy.master_weights:
             master = _precision.upcast_params(model_params)
         else:
@@ -125,10 +193,29 @@ class MixedPrecisionOptimizer:
         ``found_inf_reducer`` lets callers all-reduce the overflow flag across
         a mesh axis (the model-parallel reduction of
         apex/transformer/amp/grad_scaler.py:25-36).
+
+        Under ``zero_axis``, ``scaled_grads`` must be the *unreduced*
+        local-mean grads — the psum_scatter IS the data-axis reduction
+        (same 1/n averaging factor as ``allreduce_gradients``); reduce over
+        every OTHER grad axis (context/pipe ties) before calling. The
+        overflow flag is pmax'd over the zero axis internally so the
+        sharded state stays bit-identical on every rank through a skipped
+        step; pass ``found_inf_reducer`` for the model/pipe axes as usual.
         """
         grads32, found_inf = state.scaler.unscale(scaled_grads, out_dtype=jnp.float32)
+        if self.zero_axis is not None:
+            from apex_tpu.parallel import collectives as _coll
+
+            # each rank unscaled a DIFFERENT local grad: the skip decision
+            # must agree along the shard axis or the chunks diverge
+            found_inf = _coll.pmax(
+                found_inf.astype(jnp.float32), self.zero_axis) > 0
         if found_inf_reducer is not None:
             found_inf = found_inf_reducer(found_inf)
+
+        if self.zero_axis is not None:
+            return self._apply_zero(
+                state, model_params, grads32, found_inf, update_kwargs)
 
         step_params = state.master if state.master is not None else model_params
 
@@ -171,6 +258,154 @@ class MixedPrecisionOptimizer:
 
             metrics["grad_norm_by_group"] = group_grad_norms(grads32)
         return new_model, MPOptState(new_inner, new_master, new_scaler), metrics
+
+    # -- the ZeRO step (contrib distributed_fused_adam.py:397-477 math) -----
+    def _apply_zero(self, state, model_params, grads32, found_inf,
+                    update_kwargs):
+        """Sharded step: scatter → inner update on chunks → compressed
+        gather. Collectives run UNCONDITIONALLY (uniform SPMD schedule —
+        a collective inside a cond branch is a lowering hazard), so the
+        overflow skip is a select back to the old chunks: the discarded
+        update's non-finites never touch state, and since ``found_inf`` is
+        axis-consistent every rank selects the same way — a skipped step
+        leaves the sharded state bit-identical on every rank."""
+        from apex_tpu.optimizers.distributed import gather_leaf, scatter_chunk
+
+        axis = self.zero_axis
+        n = lax.axis_size(axis)
+        # the scatter IS the data-axis gradient reduction; /n is the same
+        # averaging factor allreduce_gradients applies
+        g_chunks = jax.tree.map(
+            lambda g: scatter_chunk(g, n, axis) / n, grads32)
+
+        updates, stepped_inner = self.inner.update(
+            g_chunks, state.inner, state.master, **update_kwargs)
+        stepped_master = optax.apply_updates(state.master, updates)
+        keep = lambda new, old: jax.tree.map(  # noqa: E731
+            lambda a, b: jnp.where(found_inf, b, a), new, old)
+        new_master = keep(stepped_master, state.master)
+        new_inner = keep(stepped_inner, state.inner)
+
+        # all-gather the updated params; with gather_dtype the payload is
+        # compressed on the wire, then stored back in each param's dtype
+        new_model = jax.tree.map(
+            lambda c, p: gather_leaf(c, p.shape, p.dtype, axis,
+                                     gather_dtype=self.gather_dtype),
+            new_master, model_params)
+
+        new_scaler = state.scaler.update(found_inf)
+        metrics = {
+            "found_inf": found_inf,
+            "loss_scale": new_scaler.loss_scale,
+        }
+        if self.log_grad_norm:
+            # norm of the REDUCED gradient, from this rank's chunks: the
+            # per-leaf shard-psum (zero axis + the param's own sharded
+            # axes) reproduces tree_l2norm on the full tree under hybrid
+            # meshes too (chunk padding contributes exact zeros)
+            metrics["grad_norm"] = jnp.sqrt(sharded_tree_sumsq(
+                g_chunks, axis, self._zero_norm_axes))
+        if self.log_group_norms:
+            from apex_tpu.monitor.diagnose import group_grad_norms
+
+            metrics["grad_norm_by_group"] = group_grad_norms(
+                g_chunks, psum_axis=axis,
+                extra_axes=self._zero_norm_axes)
+        return new_model, MPOptState(new_inner, new_master, new_scaler), metrics
+
+    # -- ZeRO wiring helpers (host side) ------------------------------------
+    def zero_abstract_state(self, model_params, mesh, param_specs=None):
+        """Per-device ShapeDtypeStruct tree of the ZeRO :class:`MPOptState`.
+
+        Built WITHOUT binding the mesh axes (the chicken-and-egg of
+        shard_map out_specs): each leaf's local shape is derived from its
+        PartitionSpec (sharded dims divide by their axis sizes), then the
+        1-D fp32 chunk is 1/n of that, and the chunk tree is fed through
+        the real ``inner.init`` under ``eval_shape`` so arbitrarily nested
+        inner states come out with the right structure."""
+        from apex_tpu.optimizers.distributed import chunk_size
+
+        if self.zero_axis is None:
+            raise ValueError("zero_abstract_state requires zero_axis")
+        n = mesh.shape[self.zero_axis]
+        leaves, treedef = jax.tree.flatten(model_params)
+        if param_specs is None:
+            spec_leaves = [None] * len(leaves)
+        else:
+            spec_leaves = jax.tree.leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, P))
+            if len(spec_leaves) != len(leaves):
+                raise ValueError(
+                    f"param_specs tree has {len(spec_leaves)} specs for "
+                    f"{len(leaves)} params")
+
+        def chunk_struct(p, spec):
+            shape = list(p.shape)
+            for d, entry in enumerate(spec or ()):
+                if entry is None:
+                    continue
+                axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+                for ax in axes:
+                    if ax == self.zero_axis:
+                        raise ValueError(
+                            f"param of shape {tuple(p.shape)} is SHARDED over "
+                            f"the zero axis {self.zero_axis!r} — ZeRO chunks "
+                            f"require every param replicated over it (dense "
+                            f"models; reduce MoE-style data-sharded groups "
+                            f"separately)")
+                    shape[d] //= mesh.shape[ax]
+            size = 1
+            for s in shape:
+                size *= s
+            return jax.ShapeDtypeStruct((chunk_size(size, n),), jnp.float32)
+
+        def sharded_axes(spec):
+            out = []
+            for entry in (spec or ()):
+                if entry is None:
+                    continue
+                for ax in (entry if isinstance(entry, (tuple, list))
+                           else (entry,)):
+                    if ax not in out:
+                        out.append(ax)
+            return tuple(out)
+
+        self._zero_norm_axes = treedef.unflatten(
+            [sharded_axes(s) for s in spec_leaves])
+        chunks = treedef.unflatten(
+            [chunk_struct(p, s) for p, s in zip(leaves, spec_leaves)])
+        scaler = _scaler_from_policy(self.policy, **self._scaler_kwargs)
+
+        def fake_init(c):
+            return MPOptState(inner=self.inner.init(c), master=c,
+                              scaler=scaler)
+
+        return jax.eval_shape(fake_init, chunks)
+
+    def zero_state_specs(self, state, mesh):
+        """shard_map specs for a ZeRO :class:`MPOptState` (or its abstract
+        shapes): chunk leaves (1-D) carry the universal per-device spec
+        ``P(tuple(mesh.axis_names))`` — each device owns exactly its chunk,
+        with no replication assumption over ANY axis, so chunks of model-
+        and pipe-sharded params round-trip correctly too; scalars (step
+        counters, the loss-scale machine) are replicated."""
+        from apex_tpu.optimizers.distributed import state_specs as _specs
+
+        return _specs(state, tuple(mesh.axis_names))
+
+    def zero_init(self, model_params, mesh, param_specs):
+        """Initialize the sharded state from host-side (global) params.
+
+        Returns ``(opt_state, state_specs)``; thread ``state_specs``
+        through the train step's shard_map in/out specs. ``param_specs``
+        is the params' PartitionSpec tree (the same one the step uses).
+        """
+        abstract = self.zero_abstract_state(model_params, mesh, param_specs)
+        sspecs = self.zero_state_specs(abstract, mesh)
+        init = jax.jit(jax.shard_map(
+            self.init, mesh=mesh, in_specs=(param_specs,),
+            out_specs=sspecs, check_vma=False))
+        return init(model_params), sspecs
 
     # -- checkpointing (apex/amp/frontend.py:361-400) -----------------------
     def state_dict(self, state: MPOptState):

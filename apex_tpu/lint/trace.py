@@ -26,6 +26,11 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   ``psum_scatter``/``all_gather`` conjugates
   (tensor_parallel/mappings.py table 2), and a refactor that reintroduces
   one compiles without complaint -- this scanner is the only tripwire.
+- ``zero-redundancy``  (:func:`zero_redundancy_hazards`) -- a full-size
+  grad ``psum`` on the data axis in a step whose optimizer is ZeRO-sharded
+  (``MixedPrecisionOptimizer(zero_axis=...)``): the optimizer's
+  psum_scatter IS that reduction, so the surviving all-reduce silently
+  double-counts the averaging; same tripwire shape as ``sp-regression``.
 
 All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
 device work) and return plain dicts/lists of findings shaped like engine
@@ -387,6 +392,91 @@ def sequence_parallel_hazards(fn, *args,
             verb: round(n / num_layers, 3)
             for verb, n in census["activation"].items()}
     return out
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-redundancy tripwire
+# ---------------------------------------------------------------------------
+
+
+def zero_collective_census(jaxpr, zero_axis: str,
+                           min_bulk_elems: int = 1 << 12) -> Dict[str, Any]:
+    """Count collectives over ``zero_axis`` in a jaxpr, split into BULK
+    traffic (any operand OR result with >= ``min_bulk_elems`` elements —
+    gradient/param payloads; a ZeRO all_gather's per-rank operand is the
+    small 1/n chunk but its result is the full param) and the rest (the
+    loss pmean, the overflow-flag pmax, LAMB's scalar norm psums, which
+    legitimately stay all-reduces)."""
+    bulk: Counter = Counter()
+    other: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in ("psum", "pmean", "pmax", "pmin", "all_gather",
+                        "reduce_scatter", "all_to_all"):
+            continue
+        if zero_axis not in _eqn_axis_names(eqn):
+            continue
+        sizes = [int(getattr(_aval_of(v), "size", 0) or 0)
+                 for v in list(eqn.invars) + list(eqn.outvars)
+                 if _aval_of(v) is not None]
+        bucket = bulk if sizes and max(sizes) >= min_bulk_elems else other
+        bucket[name] += 1
+    return {"bulk": dict(bulk), "other": dict(other)}
+
+
+def zero_redundancy_hazards(fn, *args,
+                            zero_axis: str = "data",
+                            axes: Optional[Dict[str, int]] = None,
+                            min_bulk_elems: int = 1 << 12,
+                            **kwargs) -> Dict[str, Any]:
+    """Verify a ZeRO-sharded train step decomposed its data-axis reduction.
+
+    Traces ``fn(*args)`` under ``axes`` (name -> size bindings, e.g.
+    ``{"data": 8}``; omit when ``fn`` binds its own axes via shard_map) and
+    censuses collectives on ``zero_axis``. A ``psum``/``pmean`` with a
+    bulk operand (>= ``min_bulk_elems`` elements) is a finding: under
+    ``MixedPrecisionOptimizer(zero_axis=...)`` the data-axis gradient
+    all-reduce is subsumed by the optimizer's reduce-scatter/all-gather
+    pair (``ZERO_DECOMPOSED_PRIMS``, parallel/collectives.py;
+    optimizers/distributed.py), so a surviving full-size psum means the
+    harness still all-reduces what the scatter already reduces —
+    double-counted averaging XLA compiles without complaint. Scalar
+    collectives (loss pmean, found_inf pmax, LAMB norm psums) are exempt
+    and reported under ``census["other"]``.
+
+    Returns ``{hazard, census, bulk_psums, findings}`` — call-site counts
+    per trace, like :func:`sequence_parallel_hazards`.
+    """
+    import jax
+
+    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
+        jaxpr = fn.jaxpr
+    else:
+        env = list(axes.items()) if axes else None
+        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    census = zero_collective_census(jaxpr, zero_axis,
+                                    min_bulk_elems=min_bulk_elems)
+    n_psum = sum(n for verb, n in census["bulk"].items()
+                 if verb in ("psum", "pmean"))
+    findings = []
+    if n_psum:
+        findings.append({
+            "rule": "zero-redundancy",
+            "message": (
+                f"step jaxpr carries {n_psum} psum/pmean of bulk operands "
+                f"on the '{zero_axis}' axis alongside a ZeRO-sharded "
+                f"optimizer -- the grad all-reduce there is subsumed by "
+                f"the optimizer's psum_scatter (same averaging factor); "
+                f"drop the axis from the harness reduction "
+                f"(allreduce_gradients_by_spec(zero_axis=...))"),
+            "verb": "psum", "extra": n_psum,
+        })
+    return {
+        "hazard": bool(n_psum),
+        "census": census,
+        "bulk_psums": n_psum,
+        "findings": findings,
+    }
 
 
 # ---------------------------------------------------------------------------

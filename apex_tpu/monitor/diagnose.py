@@ -35,19 +35,40 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 
-def group_grad_norms(grads) -> Dict[str, Any]:
+def group_grad_norms(grads, psum_axis=None, extra_axes=None) -> Dict[str, Any]:
     """Per-parameter-group L2 norms of a grad pytree (traced-safe).
 
     Top-level dict keys are the groups (``wte``/``layers``/... for the
     GPT models); a non-dict tree reports one ``<params>`` row. The
     per-group reduction reuses ``tree_l2norm`` so the breakdown matches
     the global ``grad_norm`` metric's semantics exactly.
+
+    ``psum_axis``: when every leaf is a 1/n shard of the true tensor (the
+    ZeRO chunks of ``MixedPrecisionOptimizer(zero_axis=...)``), per-group
+    squared partials are psum'd over that mesh axis before the sqrt, so
+    the breakdown reports the same numbers as the replicated path.
+    ``extra_axes`` (a pytree matching ``grads`` whose leaves are tuples
+    of mesh-axis names) additionally psums each leaf over the axes its
+    param is SHARDED over, so tp/pp-hybrid meshes also match.
     """
     from apex_tpu.ops.multi_tensor import tree_l2norm
 
+    if psum_axis is None:
+        def norm(tree, extras=None):
+            return tree_l2norm(tree)
+    else:
+        import jax.numpy as jnp
+
+        from apex_tpu.optimizers._common import sharded_tree_sumsq
+
+        def norm(tree, extras=None):
+            return jnp.sqrt(sharded_tree_sumsq(tree, psum_axis, extras))
+
     if isinstance(grads, dict) and grads:
-        return {str(k): tree_l2norm(v) for k, v in grads.items()}
-    return {"<params>": tree_l2norm(grads)}
+        return {str(k): norm(v, None if extra_axes is None
+                             else extra_axes[k])
+                for k, v in grads.items()}
+    return {"<params>": norm(grads, extra_axes)}
 
 
 def _scalar(v) -> Optional[float]:

@@ -138,6 +138,7 @@ class MetricsJournal:
         self._n = 0
         self.overflows = 0  # cumulative found_inf count (skip counter)
         self._step_costs: Optional[Dict[str, Any]] = None
+        self._opt_state_bytes: Optional[int] = None
         if meta:
             self.log(dict(meta, kind="meta"))
 
@@ -165,6 +166,16 @@ class MetricsJournal:
         }
         if method:
             self._step_costs["method"] = method
+
+    # -- optimizer-state arming (monitor/hbm.py) ----------------------------
+    def set_opt_state_bytes(self, nbytes: int) -> None:
+        """Arm a per-record ``opt_state_bytes`` field: the per-rank
+        optimizer-state footprint (``monitor.hbm.opt_state_bytes`` of the
+        live state — 1/dp of the replicated number under
+        ``MixedPrecisionOptimizer(zero_axis=...)``). A static host-side
+        value stamped into every subsequent step record so journals from
+        replicated and ZeRO runs compare on the claim directly."""
+        self._opt_state_bytes = int(nbytes)
 
     # -- rank info (utils/log_util.py's RankInfoFilter, journal-side) -------
     @staticmethod
@@ -270,6 +281,8 @@ class MetricsJournal:
                 self.overflows += 1
         if scaler is not None:
             rec.update(scaler_state(scaler))
+        if self._opt_state_bytes is not None:
+            rec["opt_state_bytes"] = self._opt_state_bytes
         rec["overflows"] = self.overflows
         rec.update(extra)
         self._n += 1

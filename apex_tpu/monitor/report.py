@@ -232,6 +232,14 @@ def analyze(
         if bw:
             out["mfu"]["hbm_bw_util_p50"] = _dist(bw).get("p50")
 
+    # optimizer-state footprint (journals armed via set_opt_state_bytes —
+    # the per-rank ZeRO claim: bytes/rank ÷ dp vs a replicated run)
+    osb = [r["opt_state_bytes"] for r in steps
+           if isinstance(r.get("opt_state_bytes"), (int, float))]
+    if osb:
+        out["opt_state_bytes"] = {"last": int(osb[-1]),
+                                  "peak": int(max(osb))}
+
     # overflow / forensics / recompile rollups
     overflows = [r["overflows"] for r in steps
                  if isinstance(r.get("overflows"), (int, float))]
@@ -311,6 +319,10 @@ def render(analysis: Dict[str, Any], file=None) -> None:
         for axis, row in sorted(comm.items()):
             p(f"comm[{axis}]: {row['bytes'] / 1e6:.2f} MB over "
               f"{row['calls']} call site(s)")
+    osb = analysis.get("opt_state_bytes")
+    if osb:
+        p(f"opt state: {osb['last'] / 1e6:.1f} MB/rank "
+          f"(peak {osb['peak'] / 1e6:.1f} MB)")
     p(f"overflows: {analysis.get('overflows', 0)}")
     fo = analysis.get("forensics")
     if fo:

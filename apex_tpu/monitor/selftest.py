@@ -22,7 +22,9 @@ that the monitor pieces stay importable and functional:
 8. lint: the source-invariant linter (``apex_tpu.lint``) reports the tree
    clean (all suppressions justified) and the trace analyzers reproduce
    the known hazards — the d=32/(sq,1) lane-padding numbers, the bare
-   ``pmean(loss)``-under-grad transpose, python-scalar signature leaks.
+   ``pmean(loss)``-under-grad transpose, python-scalar signature leaks,
+   and the ZeRO double-reduction tripwire (a bulk data-axis grad psum
+   alongside a sharded optimizer; the decomposed scatter/gather passes).
 
 Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
 proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
@@ -306,6 +308,29 @@ def _check_lint() -> dict:
         {"scale": 2.0, "x": jnp.ones((2,), jnp.float32)},
         weak=jnp.asarray(1.0))
     assert sorted(h["kind"] for h in haz) == ["python-scalar", "weak-type"], haz
+
+    # engine 2, ZeRO tripwire: a full-size grad psum on the data axis is
+    # the double-reduction regression; the optimizer's decomposed
+    # psum_scatter/all_gather chunk path passes (scalar loss/overflow
+    # collectives are exempt)
+    from apex_tpu.optimizers.distributed import gather_leaf, scatter_chunk
+
+    big = jnp.ones((64, 128), jnp.float32)  # 8192 elems: bulk
+    zr_bad = lint_trace.zero_redundancy_hazards(
+        lambda g: lax.psum(g, "data") + lax.pmax(jnp.sum(g), "data"),
+        big, axes={"data": 8})
+    assert zr_bad["hazard"] and zr_bad["bulk_psums"] == 1, zr_bad
+    assert zr_bad["census"]["other"].get("pmax") == 1, zr_bad
+
+    def zr_good(g):
+        chunk = scatter_chunk(g, 8, "data") / 8
+        return gather_leaf(chunk, g.shape, g.dtype, "data",
+                           gather_dtype=jnp.bfloat16)
+
+    zr_ok = lint_trace.zero_redundancy_hazards(zr_good, big,
+                                               axes={"data": 8})
+    assert not zr_ok["hazard"], zr_ok
+    assert zr_ok["census"]["bulk"].get("reduce_scatter") == 1, zr_ok
 
     # engine 2, sequence-parallel tripwire: an activation psum on the TP
     # axis is the regression; the reduce_scatter/all_gather conjugates and

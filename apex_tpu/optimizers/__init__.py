@@ -29,5 +29,6 @@ from apex_tpu.optimizers.distributed import (  # noqa: F401
     DistributedFusedSGD,
     abstract_state,
     distributed_fused,
+    sharded_state_shapes,
     state_specs,
 )

@@ -20,6 +20,50 @@ def tree_zeros_like(params, dtype=jnp.float32):
     return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=dtype), params)
 
 
+def tree_sumsq(tree) -> jax.Array:
+    """fp32 sum of squares over every float leaf (the first stage of
+    ``multi_tensor_l2norm``, csrc/multi_tensor_l2norm_kernel.cu). Shared by
+    the sharded-norm paths (ZeRO grad-norm metrics, LAMB's inter-shard
+    norms): callers psum the scalar across the shard axis, then sqrt.
+    Uses ``tree_l2norm``'s float-leaf filter so the sharded and replicated
+    norm semantics cannot drift."""
+    from apex_tpu.ops.multi_tensor import _float_leaves
+
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return total
+
+
+def sharded_tree_sumsq(tree, axes, extra_axes=None) -> jax.Array:
+    """Global fp32 sum of squares of a *sharded* tree, inside shard_map.
+
+    Per-leaf squared partials are psum'd over ``axes`` plus that leaf's
+    entry in ``extra_axes`` — a matching pytree whose leaves are tuples of
+    the mesh axes the underlying param is SHARDED over — so shards of
+    model/pipe-sharded params count exactly once while replicated leaves
+    are not double-counted under hybrid meshes. Leaves sharing an axis
+    set share one psum. ``extra_axes=None`` reduces every leaf over
+    ``axes`` alone (``== collectives.psum(tree_sumsq(tree), axes)``)."""
+    from apex_tpu.parallel import collectives
+
+    base = (axes,) if isinstance(axes, str) else tuple(axes)
+    g_leaves, treedef = jax.tree.flatten(tree)
+    e_leaves = ([()] * len(g_leaves) if extra_axes is None
+                else treedef.flatten_up_to(extra_axes))
+    by_axes: dict = {}
+    for g, extra in zip(g_leaves, e_leaves):
+        key = base + tuple(a for a in tuple(extra) if a not in base)
+        by_axes.setdefault(key, []).append(g)
+    total = jnp.zeros((), jnp.float32)
+    for key, leaves in by_axes.items():
+        total = total + collectives.psum(tree_sumsq(leaves), key)
+    return total
+
+
 def multi_tree_map(fn, *trees, n_out: int):
     """Map ``fn`` over N parallel trees where fn returns an ``n_out``-tuple;
     returns ``n_out`` trees. The structural analog of a multi_tensor kernel

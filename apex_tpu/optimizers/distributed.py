@@ -21,9 +21,14 @@ XLA, overlap is the latency-hiding scheduler's job; what remains is the ZeRO
 way; per-leaf chunks are 1-D slices of the flattened leaf, padded to the axis
 size. LAMB needs its per-tensor trust-ratio norms summed across shards, so
 ``fused_lamb`` grows a ``norm_psum_axis`` and ``DistributedFusedLAMB`` passes
-it through. The e5m2-compressed allgather option (:64) is deliberately
-dropped — bf16 params already halve gather bytes and XLA has no sub-byte
-float collectives.
+it through. The reference's e5m2-compressed allgather option (:64) maps to
+``gather_dtype``: the updated chunk is cast (bf16 is the TPU-native choice —
+XLA has no sub-byte float collectives) *before* the all-gather, so the
+broadcast payload halves while the fp32 masters stay exact.
+
+The chunk helpers (``local_chunk``/``scatter_chunk``/``gather_leaf``) are
+public: ``amp.MixedPrecisionOptimizer(zero_axis=...)`` reuses them to run the
+whole O2 master/moment state ZeRO-sharded (amp/frontend.py).
 
 Usage (inside shard_map over the ``data`` axis — grads enter *unreduced*,
 the scatter IS the gradient reduction, like the reference's hook-driven
@@ -40,7 +45,7 @@ axis)`` (moment leaves are sharded on the axis; the step scalar replicated).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +53,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.monitor.comms import collective_scope as _comm
 from apex_tpu.optimizers._common import ClassOptimizer
 from apex_tpu.optimizers.fused_adam import fused_adam
 from apex_tpu.optimizers.fused_lamb import fused_lamb
@@ -57,6 +63,11 @@ from apex_tpu.parallel.mesh import AXIS_DATA
 
 def _padded_size(n_elems: int, n_shards: int) -> int:
     return ((n_elems + n_shards - 1) // n_shards) * n_shards
+
+
+def chunk_size(n_elems: int, n_shards: int) -> int:
+    """Per-shard 1-D chunk length of a leaf with ``n_elems`` elements."""
+    return _padded_size(n_elems, n_shards) // n_shards
 
 
 def _flat_padded(x: jax.Array, n: int) -> jax.Array:
@@ -69,30 +80,51 @@ def _flat_padded(x: jax.Array, n: int) -> jax.Array:
     return flat
 
 
-def _local_chunk(x: jax.Array, n: int, idx) -> jax.Array:
+def local_chunk(x: jax.Array, n: int, idx) -> jax.Array:
     """This shard's 1-D chunk of a leaf (flatten → zero-pad → slice)."""
     flat = _flat_padded(x, n)
     k = flat.size // n
     return lax.dynamic_slice(flat, (idx * k,), (k,))
 
 
-def _scatter_chunk(x: jax.Array, n: int, axis: str) -> jax.Array:
-    """Reduce-scatter a full (replica-partial) leaf into this rank's chunk."""
-    return lax.psum_scatter(
-        _flat_padded(x, n), axis, scatter_dimension=0, tiled=True
-    )
+def scatter_chunk(x: jax.Array, n: int, axis: str) -> jax.Array:
+    """Reduce-scatter a full (replica-partial) leaf into this rank's chunk.
+
+    This IS the data-parallel gradient reduction of the ZeRO step (the
+    reference's hook-driven reduce-scatter subsumes DDP allreduce,
+    distributed_fused_adam.py:397-441): callers divide by the axis size for
+    gradient averaging."""
+    flat = _flat_padded(x, n)
+    with _comm("psum_scatter", axis, flat):
+        return lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True)
 
 
-def _gather_leaf(chunk: jax.Array, shape, dtype, axis: str) -> jax.Array:
-    """All-gather chunks back into the full leaf shape. The chunk is cast to
-    the param dtype *before* the collective so a bf16 gather moves half the
-    bytes (the role of the reference's e5m2-compressed allgather option,
-    distributed_fused_adam.py:64)."""
-    full = lax.all_gather(chunk.astype(dtype), axis, axis=0, tiled=True)
+def gather_leaf(
+    chunk: jax.Array,
+    shape,
+    dtype,
+    axis: str,
+    gather_dtype: Optional[Any] = None,
+) -> jax.Array:
+    """All-gather chunks back into the full leaf shape.
+
+    The chunk is cast to ``gather_dtype`` (default: the param dtype)
+    *before* the collective so a bf16 gather moves half the bytes — the
+    role of the reference's e5m2-compressed allgather option
+    (distributed_fused_adam.py:64). The comm scope sees the CAST payload,
+    so ``monitor.comms`` tallies the gather at its true wire dtype."""
+    payload = chunk.astype(gather_dtype if gather_dtype is not None else dtype)
+    with _comm("all_gather", axis, payload):
+        full = lax.all_gather(payload, axis, axis=0, tiled=True)
     n_elems = 1
     for s in shape:
         n_elems *= s
-    return full[:n_elems].reshape(shape)
+    return full[:n_elems].reshape(shape).astype(dtype)
+
+
+# backward-compat private aliases (pre-ZeRO-frontend spelling)
+_local_chunk = local_chunk
+_scatter_chunk = scatter_chunk
 
 
 def distributed_fused(
@@ -100,6 +132,7 @@ def distributed_fused(
     axis: str = AXIS_DATA,
     *,
     grad_average: bool = True,
+    gather_dtype: Optional[Any] = None,
 ) -> optax.GradientTransformation:
     """Wrap a fused transform with ZeRO sharding over a mesh axis.
 
@@ -108,13 +141,16 @@ def distributed_fused(
     data-parallel reduction, like the reference's reduce-scatter pipeline
     subsumes DDP allreduce); ``grad_average=True`` divides by the axis size
     (gradient averaging, distributed_fused_adam.py predivide semantics).
+    ``gather_dtype`` compresses the update all-gather's payload (the
+    reference's e5m2 allgather knob, :64); the update is still applied in
+    each param's own dtype.
     """
 
     def init_fn(params):
         n = lax.axis_size(axis)
         idx = lax.axis_index(axis)
         chunks = jax.tree.map(
-            lambda p: _local_chunk(p.astype(jnp.float32), n, idx), params
+            lambda p: local_chunk(p.astype(jnp.float32), n, idx), params
         )
         return inner.init(chunks)
 
@@ -124,16 +160,17 @@ def distributed_fused(
         n = lax.axis_size(axis)
         idx = lax.axis_index(axis)
         g_chunks = jax.tree.map(
-            lambda g: _scatter_chunk(g.astype(jnp.float32), n, axis)
+            lambda g: scatter_chunk(g.astype(jnp.float32), n, axis)
             / (n if grad_average else 1),
             grads,
         )
         p_chunks = jax.tree.map(
-            lambda p: _local_chunk(p.astype(jnp.float32), n, idx), params
+            lambda p: local_chunk(p.astype(jnp.float32), n, idx), params
         )
         upd_chunks, new_state = inner.update(g_chunks, state, p_chunks, **extra)
         updates = jax.tree.map(
-            lambda u, p: _gather_leaf(u, p.shape, p.dtype, axis),
+            lambda u, p: gather_leaf(u, p.shape, p.dtype, axis,
+                                     gather_dtype=gather_dtype),
             upd_chunks,
             params,
         )
@@ -142,31 +179,46 @@ def distributed_fused(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def state_specs(state: Any, axis: str = AXIS_DATA) -> Any:
-    """shard_map out-specs for a distributed_fused state: array leaves are
-    sharded on ``axis``, scalars (step counters) replicated."""
+def state_specs(state: Any, axis: Any = AXIS_DATA) -> Any:
+    """shard_map out-specs for a ZeRO-sharded optimizer state.
+
+    Recurses through arbitrarily nested states — named tuples, chained
+    transforms (``optax.chain`` returns a tuple of per-transform states),
+    dicts — and marks exactly the 1-D leaves as sharded on ``axis``:
+    chunks are 1-D *by construction* (``local_chunk`` flattens), so any
+    scalar (step counters) or higher-rank leaf a nested inner transform
+    carries is replicated rather than silently mis-sharded. ``axis`` may
+    be a tuple of mesh axis names: chunks of model-sharded params differ
+    across every axis, so the universal per-device spec is
+    ``P(tuple(mesh.axis_names))`` (amp/frontend.py's ZeRO path).
+    """
+    spec = P(tuple(axis) if isinstance(axis, (tuple, list)) else axis)
     return jax.tree.map(
-        lambda x: P(axis) if getattr(x, "ndim", 0) >= 1 else P(), state
+        lambda x: spec if getattr(x, "ndim", 0) == 1 else P(), state
     )
 
 
-def abstract_state(
+def sharded_state_shapes(
     inner: optax.GradientTransformation, params: Any, n_shards: int
 ) -> Any:
     """ShapeDtypeStruct pytree of a ``distributed_fused(inner)`` state as seen
     per device — for building shard_map out_specs (with ``state_specs``)
-    without binding the mesh axis."""
+    without binding the mesh axis. Handles any nesting the inner transform's
+    ``init`` produces (chained/named-tuple states included): the abstract
+    chunk tree is fed through the real ``inner.init`` under ``eval_shape``."""
 
     def fake_init(p):
         chunks = jax.tree.map(
-            lambda x: jnp.zeros(
-                (_padded_size(x.size, n_shards) // n_shards,), jnp.float32
-            ),
+            lambda x: jnp.zeros((chunk_size(x.size, n_shards),), jnp.float32),
             p,
         )
         return inner.init(chunks)
 
     return jax.eval_shape(fake_init, params)
+
+
+#: pre-r8 name of :func:`sharded_state_shapes`
+abstract_state = sharded_state_shapes
 
 
 class DistributedFusedAdam(ClassOptimizer):
@@ -187,6 +239,7 @@ class DistributedFusedAdam(ClassOptimizer):
         weight_decay=0.0,
         axis: str = AXIS_DATA,
         grad_average: bool = True,
+        gather_dtype: Optional[Any] = None,
         **_ignored,
     ):
         super().__init__(
@@ -201,6 +254,7 @@ class DistributedFusedAdam(ClassOptimizer):
                 ),
                 axis=axis,
                 grad_average=grad_average,
+                gather_dtype=gather_dtype,
             ),
             lr=lr,
         )
@@ -227,6 +281,7 @@ class DistributedFusedLAMB(ClassOptimizer):
         use_nvlamb=False,
         axis: str = AXIS_DATA,
         grad_average: bool = True,
+        gather_dtype: Optional[Any] = None,
         **_ignored,
     ):
         super().__init__(
@@ -245,6 +300,7 @@ class DistributedFusedLAMB(ClassOptimizer):
                 ),
                 axis=axis,
                 grad_average=grad_average,
+                gather_dtype=gather_dtype,
             ),
             lr=lr,
         )

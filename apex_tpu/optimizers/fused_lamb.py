@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import optax
 
 from apex_tpu.ops.multi_tensor import tree_l2norm
+from apex_tpu.parallel import collectives
 from apex_tpu.optimizers._common import (
     ClassOptimizer,
     cast_like,
@@ -53,7 +54,10 @@ def fused_lamb(
     def _sumsq(x):
         s = jnp.sum(jnp.square(x))
         if norm_psum_axis is not None:
-            s = jax.lax.psum(s, norm_psum_axis)
+            # scoped verb (parallel/collectives.py): the per-tensor norm
+            # psums are real shard-axis traffic the comm accounting and
+            # trace-join attribution must see
+            s = collectives.psum(s, norm_psum_axis)
         return s
     if not adam_w_mode:
         raise RuntimeError("FusedLAMB only supports adam_w_mode (decoupled wd), as the reference kernel does.")
@@ -130,8 +134,14 @@ class FusedLAMB(ClassOptimizer):
         adam_w_mode=True,
         max_grad_norm=1.0,
         use_nvlamb=False,
+        norm_psum_axis=None,
         **_ignored,
     ):
+        # norm_psum_axis: set to the ZeRO shard axis when this transform
+        # runs over 1/n chunks (amp.MixedPrecisionOptimizer(zero_axis=...));
+        # per-tensor trust-ratio and global-clip norms then sum squared
+        # partials across the shards (DistributedFusedLAMB's inter-rank
+        # L2-norm allreduce)
         super().__init__(
             fused_lamb(
                 lr=lr,
@@ -143,6 +153,7 @@ class FusedLAMB(ClassOptimizer):
                 adam_w_mode=adam_w_mode,
                 max_grad_norm=max_grad_norm,
                 use_nvlamb=use_nvlamb,
+                norm_psum_axis=norm_psum_axis,
             ),
             lr=lr,
         )

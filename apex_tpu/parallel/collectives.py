@@ -65,6 +65,14 @@ COMM_SCOPE_HELPERS = ("_comm", "collective_scope",
 # decomposition silently regressed to a synchronous all-reduce.
 SEQUENCE_PARALLEL_DECOMPOSED_PRIMS = ("reduce_scatter", "all_gather")
 
+# The same contract for the ZeRO optimizer path
+# (apex_tpu.lint.trace.zero_redundancy_hazards): in a step whose optimizer
+# is sharded over the data axis, BULK gradient traffic there must appear
+# only as the reduce-scatter/all-gather conjugate pair
+# (optimizers/distributed.py) — a full-size grad ``psum`` on that axis
+# means the step still all-reduces what the scatter already reduces.
+ZERO_DECOMPOSED_PRIMS = ("reduce_scatter", "all_gather")
+
 #: every verb in this module must run under a ``comm:`` scope; the marker
 #: opts the file into the lint rule even if the import shape changes
 LINT_COMM_SCOPE = True

@@ -80,6 +80,7 @@ def allreduce_gradients_by_spec(
     *,
     data_axes: AxisNames = (AXIS_DATA, AXIS_CONTEXT),
     replicated_axes: Sequence[str] = (AXIS_PIPE,),
+    zero_axis: Optional[str] = None,
     **opts,
 ) -> Any:
     """Spec-aware gradient reduction for hybrid-parallel training.
@@ -95,8 +96,17 @@ def allreduce_gradients_by_spec(
     (parallel_state.py:165-184): stage-masked contributions (input
     embedding on the first stage, LM head on the last) sum to the total
     tied gradient.
+
+    ``zero_axis`` drops that axis from ``data_axes``: with a ZeRO-sharded
+    optimizer (``amp.MixedPrecisionOptimizer(zero_axis=...)``) the
+    optimizer's psum_scatter IS the reduction over it — same averaging
+    factor — and a second all-reduce here would double-count (the
+    ``lint.trace.zero_redundancy_hazards`` tripwire). Every other axis
+    (context partial-grads, pipe embedding ties) still reduces here.
     """
     data_axes = (data_axes,) if isinstance(data_axes, str) else tuple(data_axes)
+    if zero_axis is not None:
+        data_axes = tuple(a for a in data_axes if a != zero_axis)
 
     def _reduce(g, spec):
         spec_axes = set()

@@ -242,6 +242,13 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
     from apex_tpu.optimizers import FusedAdam
 
     fused = policy_level == "O2"
+    # BENCH_ZERO=1 arms the ZeRO optimizer path (fp32 masters + moments
+    # sharded over a data mesh, psum_scatter/bf16-gather inside the step).
+    # On this single-chip target the data axis has size 1 — the collectives
+    # are degenerate — but the rung exercises the exact end-to-end program
+    # a dp>1 pod runs, through the tunnel, with rung provenance recording
+    # it. Off by default: the headline program stays byte-identical.
+    zero = bool(os.environ.get("BENCH_ZERO"))
     cfg = GPTConfig(
         vocab_size=50304,
         hidden_size=hidden or int(os.environ.get("BENCH_HIDDEN", "1024")),
@@ -269,8 +276,33 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
     # un-journaled headline program must stay byte-identical to pre-journal
     # rounds so cross-round deltas attribute to code under test
     mp_opt = amp.MixedPrecisionOptimizer(
-        opt, policy, log_grad_norm=bool(os.environ.get("BENCH_JOURNAL")))
+        opt, policy, log_grad_norm=bool(os.environ.get("BENCH_JOURNAL")),
+        zero_axis="data" if zero else None,
+        gather_dtype="bf16" if (zero and fused) else None)
     params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+
+    if zero:
+        import numpy as _np
+        from jax.sharding import Mesh, PartitionSpec as _P
+
+        mesh = Mesh(_np.array(jax.devices()[:1]), ("data",))
+        pspecs = jax.tree.map(lambda _: _P(), params)
+        opt_state, zero_specs = mp_opt.zero_init(params, mesh, pspecs)
+
+        def zero_step(p, s, tokens, targets):
+            def scaled_loss(p):
+                return mp_opt.scale_loss(model.loss(p, tokens, targets), s)
+
+            loss_s, grads_s = jax.value_and_grad(scaled_loss)(p)
+            new_p, new_s, metrics = mp_opt.apply_gradients(s, p, grads_s)
+            return new_p, new_s, loss_s, metrics
+
+        step = jax.shard_map(
+            zero_step, mesh=mesh,
+            in_specs=(pspecs, zero_specs, _P(), _P()),
+            out_specs=(pspecs, zero_specs, _P(), _P()), check_vma=False)
+        return step, params, opt_state
+
     opt_state = mp_opt.init(params)
 
     def step(params, opt_state, tokens, targets):
@@ -405,7 +437,9 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
                                            prep[4][0], prep[4][1], batch, seq)
                 return prep + (batch, {"remat": remat_policy or "full",
                                        "scan": scan_chunk,
-                                       "unroll": unroll})
+                                       "unroll": unroll,
+                                       "zero": bool(
+                                           os.environ.get("BENCH_ZERO"))})
             except Exception as e:  # noqa: BLE001 - jaxlib error types vary
                 if not _is_oom(e):
                     raise

@@ -42,16 +42,20 @@ from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 # the reference grid, gpt_scaling_test.py:52 — extended with one
 # context-parallel config (dp, tp, pp, cp): ring-attention sequence
 # sharding is this framework's beyond-reference axis and belongs in the
-# round-over-round scaling record. A 5th "sp" element marks Megatron-style
-# sequence parallelism on the TP axis (GPTConfig.sequence_parallel): the
-# sweep records its comm/static-hazard blocks next to the plain-TP twin so
-# the decomposed-collective structure shows up in scaling_table.json.
-GRID = [(8, 1, 1), (4, 2, 1), (4, 2, 1, 1, "sp"), (2, 1, 4), (1, 2, 4),
-        (2, 1, 2, 2)]
+# round-over-round scaling record. Trailing string markers: "sp" =
+# Megatron-style sequence parallelism on the TP axis
+# (GPTConfig.sequence_parallel), "zero" = ZeRO-sharded optimizer over the
+# data axis (amp.MixedPrecisionOptimizer(zero_axis="data") with a bf16-
+# compressed param gather). Each marked config records its comm/static-
+# hazard blocks next to the plain twin so the decomposed-collective
+# structure shows up in scaling_table.json.
+GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (4, 2, 1), (4, 2, 1, 1, "sp"),
+        (2, 1, 4), (1, 2, 4), (2, 1, 2, 2)]
 
 
 def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
-               micro_batch, n_micro, steps, sequence_parallel=False):
+               micro_batch, n_micro, steps, sequence_parallel=False,
+               zero=False):
     n_dev = dp * tp * pp * cp
     if len(jax.devices()) < n_dev:
         return None
@@ -73,12 +77,14 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         )
         model = GPTModel(cfg)
         policy = amp.get_policy("O2")
-        mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-4), policy)
+        mp_opt = amp.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-4), policy,
+            zero_axis=mesh_lib.AXIS_DATA if zero else None,
+            gather_dtype="bf16" if zero else None)
         full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
         # shared TP x PP wiring (specs, placement, pipelined loss)
         specs, params, pipe_loss = prepare_pipelined_model(
             model, full, mesh, num_microbatches=n_micro)
-        opt_state = mp_opt.init(params)
         rest_specs = {k: v for k, v in specs.items() if k != "layers"}
         grad_axes = mesh_lib.get_gradient_reduction_axes()
         data_spec = P(mesh_lib.AXIS_DATA,
@@ -96,16 +102,31 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             lg = allreduce_gradients(lg, grad_axes)
             return collectives.pmean(loss, grad_axes), dict(rg, layers=lg)
 
-        shard_fn = jax.shard_map(
-            sharded_grads, mesh=mesh,
-            in_specs=(specs, data_spec, data_spec, P()),
-            out_specs=(P(), specs), check_vma=False)
+        if zero:
+            # ZeRO: the sharded optimizer's collectives live inside the
+            # step's shard_map; the data axis drops from the harness
+            # reduction (the scatter IS it) — the comm_accounting block
+            # below then shows psum_scatter + all_gather instead of the
+            # data-axis grad psum
+            from apex_tpu.transformer.amp import build_zero_train_step
 
-        @jax.jit
-        def train_step(params, opt_state, tokens, targets):
-            sl, sg = shard_fn(params, tokens, targets, opt_state.scaler.loss_scale)
-            np_, ns, m = mp_opt.apply_gradients(opt_state, params, sg)
-            return np_, ns, sl / opt_state.scaler.loss_scale, m
+            opt_state, zero_specs = mp_opt.zero_init(params, mesh, specs)
+            train_step = build_zero_train_step(
+                mp_opt, mesh, specs, zero_specs, pipe_loss,
+                rest_specs=rest_specs, grad_axes=grad_axes,
+                data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA)
+        else:
+            opt_state = mp_opt.init(params)
+            shard_fn = jax.shard_map(
+                sharded_grads, mesh=mesh,
+                in_specs=(specs, data_spec, data_spec, P()),
+                out_specs=(P(), specs), check_vma=False)
+
+            @jax.jit
+            def train_step(params, opt_state, tokens, targets):
+                sl, sg = shard_fn(params, tokens, targets, opt_state.scaler.loss_scale)
+                np_, ns, m = mp_opt.apply_gradients(opt_state, params, sg)
+                return np_, ns, sl / opt_state.scaler.loss_scale, m
 
         batch = micro_batch * dp * n_micro
         rng = np.random.default_rng(0)
@@ -140,6 +161,8 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             conf["cp"] = cp
         if sequence_parallel and tp > 1:
             conf["sequence_parallel"] = True
+        if zero:
+            conf["zero"] = True
         row = {
             "config": conf,
             "avg_iteration_time_s": round(dt, 4),
@@ -262,12 +285,15 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
     for entry in grid:
         dp, tp, pp = entry[:3]
         cp = entry[3] if len(entry) > 3 else 1
-        sp = len(entry) > 4 and entry[4] == "sp"
+        marks = set(entry[4:])
+        sp = "sp" in marks
+        zero = "zero" in marks
         for layers in layers_list:
             res = run_config(
                 dp, tp, pp, cp, hidden=hidden, layers=layers, heads=heads,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
-                n_micro=n_micro, steps=steps, sequence_parallel=sp)
+                n_micro=n_micro, steps=steps, sequence_parallel=sp,
+                zero=zero)
             if res is None:
                 # not enough devices — no layer count will change that;
                 # record ONE skipped row for this config and move on
@@ -277,18 +303,21 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                     res["config"]["cp"] = cp
                 if sp:
                     res["config"]["sequence_parallel"] = True
+                if zero:
+                    res["config"]["zero"] = True
                 rows.append(res)
                 print(json.dumps(res), flush=True)
                 break
             res["config"].setdefault("layers", layers)
             eff = res["config"]["layers"]
-            # compare with cp/sp DEFAULTED ON BOTH SIDES: projecting a
-            # stored cp>1 (or sequence-parallel) row down to a smaller key
-            # set would make a later plain config look like its duplicate
-            # and silently skip it
-            defaults = {"cp": 1, "sequence_parallel": False}
+            # compare with cp/sp/zero DEFAULTED ON BOTH SIDES: projecting a
+            # stored cp>1 (or sequence-parallel/zero) row down to a smaller
+            # key set would make a later plain config look like its
+            # duplicate and silently skip it
+            defaults = {"cp": 1, "sequence_parallel": False, "zero": False}
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
-                        "sequence_parallel": sp and tp > 1, "layers": eff}
+                        "sequence_parallel": sp and tp > 1, "zero": zero,
+                        "layers": eff}
             if any({k: r["config"].get(k, defaults.get(k, 1))
                     for k in base_cfg} == base_cfg
                    for r in rows):
@@ -307,6 +336,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                 os.makedirs(output_dir, exist_ok=True)
                 cp_tag = f"_cp{cp}" if cp > 1 else ""
                 cp_tag += "_sp" if sp and tp > 1 else ""
+                cp_tag += "_zero" if zero else ""
                 name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
                 with open(os.path.join(output_dir, name), "w") as f:
                     json.dump(res, f, indent=1)
@@ -315,19 +345,20 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             json.dump({"notes": _TABLE_NOTES, "rows": rows}, f, indent=1)
     # the human-readable table the reference prints as
     # "Average Iteration Time" lines (gpt_scaling_test.py:64-70)
-    hdr = (f"{'dp':>3} {'tp':>3} {'pp':>3} {'cp':>3} {'sp':>3} "
+    hdr = (f"{'dp':>3} {'tp':>3} {'pp':>3} {'cp':>3} {'mode':>5} "
            f"{'layers':>6} {'iter_s':>9} {'tok/s':>10}")
     print(hdr)
     for r in rows:
         c = r["config"]
-        sp_mark = "sp" if c.get("sequence_parallel") else "-"
+        sp_mark = ("sp" if c.get("sequence_parallel")
+                   else "zero" if c.get("zero") else "-")
         if "skipped" in r:
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
-                  f"{c.get('cp', 1):>3} {sp_mark:>3} "
+                  f"{c.get('cp', 1):>3} {sp_mark:>5} "
                   f"{c.get('layers', '-'):>6} {'skipped':>9}")
         else:
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
-                  f"{c.get('cp', 1):>3} {sp_mark:>3} {c['layers']:>6} "
+                  f"{c.get('cp', 1):>3} {sp_mark:>5} {c['layers']:>6} "
                   f"{r['avg_iteration_time_s']:>9.4f} "
                   f"{r['tokens_per_sec']:>10.1f}")
     return rows

@@ -39,6 +39,11 @@ def parse_args():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--lr", type=float, default=2e-3)
     p.add_argument("--opt-level", default="O2")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO: data-parallel over every device with fp32 "
+                        "masters + LAMB moments sharded 1/dp "
+                        "(LAMB trust-ratio norms psum across the shards); "
+                        "batch must divide the device count")
     return p.parse_args()
 
 
@@ -63,21 +68,71 @@ def main():
     )
     model = BertModel(cfg)
     policy = amp.get_policy(args.opt_level)
-    # FusedLAMB: the layer-adaptive optimizer the reference pairs with BERT
-    mp_opt = amp.MixedPrecisionOptimizer(
-        FusedLAMB(lr=args.lr, weight_decay=0.01), policy)
-    params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
-    state = mp_opt.init(params)
+    if args.zero:
+        # ZeRO over every local device: local-mean loss per batch shard,
+        # unreduced grads into the sharded LAMB step (the psum_scatter is
+        # the gradient averaging; norm_psum_axis restores exact per-tensor
+        # trust ratios across the chunks), bf16-compressed param gather
+        from jax.sharding import Mesh, PartitionSpec as P
 
-    @jax.jit
-    def train_step(p, s, toks, attn, lmask, labels, nsp, types):
-        def scaled(p):
-            return mp_opt.scale_loss(
-                model.loss(p, toks, attn, lmask, labels, nsp, types), s)
+        from apex_tpu.parallel import collectives
+        from apex_tpu.utils.compat import ensure_jax_compat
 
-        ls, gs = jax.value_and_grad(scaled)(p)
-        np_, ns, m = mp_opt.apply_gradients(s, p, gs)
-        return np_, ns, ls / s.scaler.loss_scale, m
+        ensure_jax_compat()  # jax<0.5: shard_map/axis_size API renames
+        n_dev = len(jax.devices())
+        if args.batch % n_dev:
+            raise SystemExit(f"--batch {args.batch} must divide the "
+                             f"device count {n_dev} under --zero")
+        mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        mp_opt = amp.MixedPrecisionOptimizer(
+            FusedLAMB(lr=args.lr, weight_decay=0.01,
+                      norm_psum_axis="data"),
+            policy, zero_axis="data",
+            # bf16 gather is free only when the model params already live
+            # in half precision (cast O2/O3); for fp32-param policies
+            # (O0/O1) it would round the weights every step.
+            gather_dtype="bf16" if policy.cast_model_type is not None
+            else None)
+        params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+        pspecs = jax.tree.map(lambda _: P(), params)
+        state, zero_specs = mp_opt.zero_init(params, mesh, pspecs)
+        data_spec = P("data")
+
+        def zero_step(p, s, toks, attn, lmask, labels, nsp, types):
+            def scaled(p):
+                return mp_opt.scale_loss(
+                    model.loss(p, toks, attn, lmask, labels, nsp, types), s)
+
+            ls, gs = jax.value_and_grad(scaled)(p)
+            np_, ns, m = mp_opt.apply_gradients(s, p, gs)
+            return np_, ns, collectives.pmean(ls, "data"), m
+
+        zero_fn = jax.shard_map(
+            zero_step, mesh=mesh,
+            in_specs=(pspecs, zero_specs) + (data_spec,) * 6,
+            out_specs=(pspecs, zero_specs, P(), P()), check_vma=False)
+
+        @jax.jit
+        def train_step(p, s, *batch):
+            np_, ns, ls, m = zero_fn(p, s, *batch)
+            return np_, ns, ls / s.scaler.loss_scale, m
+    else:
+        # FusedLAMB: the layer-adaptive optimizer the reference pairs
+        # with BERT
+        mp_opt = amp.MixedPrecisionOptimizer(
+            FusedLAMB(lr=args.lr, weight_decay=0.01), policy)
+        params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+        state = mp_opt.init(params)
+
+        @jax.jit
+        def train_step(p, s, toks, attn, lmask, labels, nsp, types):
+            def scaled(p):
+                return mp_opt.scale_loss(
+                    model.loss(p, toks, attn, lmask, labels, nsp, types), s)
+
+            ls, gs = jax.value_and_grad(scaled)(p)
+            np_, ns, m = mp_opt.apply_gradients(s, p, gs)
+            return np_, ns, ls / s.scaler.loss_scale, m
 
     if args.steps < 2:
         raise SystemExit("--steps must be >= 2 (step 0 is compile warmup)")

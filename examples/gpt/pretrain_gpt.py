@@ -67,6 +67,13 @@ def parse_args():
                    help="drive the layer stack with static slices instead "
                         "of lax.scan (kills the scan backward's grad "
                         "stacking, PERF_NOTES r5; compile time O(depth))")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO: shard fp32 masters + Adam moments over the "
+                        "data axis (optimizer memory / dp; the grad "
+                        "all-reduce becomes psum_scatter + all_gather)")
+    p.add_argument("--zero-gather", default=None, choices=["bf16"],
+                   help="compress the ZeRO param all-gather payload "
+                        "(halves gather bytes; fp32 masters stay exact)")
     p.add_argument("--data", default=None, help="dir of .bin int32 token files")
     p.add_argument("--save-dir", default=None)
     p.add_argument("--save-every", type=int, default=100)
@@ -75,7 +82,10 @@ def parse_args():
                         "(apex_tpu.monitor: wall time, tokens/s, loss, "
                         "grad-norm, loss-scale state, HBM samples); adds "
                         "one loss fetch per step")
-    return p.parse_args()
+    args = p.parse_args()
+    if args.zero_gather and not args.zero:
+        p.error("--zero-gather requires --zero")
+    return args
 
 
 def main():
@@ -107,9 +117,12 @@ def main():
     # journaled runs also want the global grad-norm AND the per-group
     # breakdown (overflow forensics, monitor/diagnose.py) in the metrics;
     # un-journaled programs stay byte-identical (both flags default off)
-    mp_opt = amp.MixedPrecisionOptimizer(FusedAdam(lr=args.lr), policy,
-                                         log_grad_norm=bool(args.journal),
-                                         log_group_norms=bool(args.journal))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=args.lr), policy,
+        log_grad_norm=bool(args.journal),
+        log_group_norms=bool(args.journal),
+        zero_axis=mesh_lib.AXIS_DATA if args.zero else None,
+        gather_dtype=args.zero_gather)
 
     full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
     all_specs = model.specs()
@@ -118,7 +131,6 @@ def main():
         layers=pipeline_specs(all_specs["layers"]),
     )
     params = tp_mod.shard_params(full, specs, mesh)
-    opt_state = mp_opt.init(params)
 
     batch = args.micro_batch * dp * args.num_microbatches
     data_spec = P(mesh_lib.AXIS_DATA)
@@ -143,19 +155,35 @@ def main():
         layer_g = allreduce_gradients(layer_g, grad_axes)
         return collectives.pmean(loss, grad_axes), dict(rest_g, layers=layer_g)
 
-    shard_fn = jax.shard_map(
-        sharded_grads, mesh=mesh,
-        in_specs=(specs, data_spec, data_spec, P()),
-        out_specs=(P(), specs), check_vma=False,
-    )
+    if args.zero:
+        # ZeRO: the whole step — backward, spec-aware reduction over every
+        # NON-data axis, and the sharded optimizer (psum_scatter → chunked
+        # Adam → compressed all_gather) — runs inside ONE shard_map; the
+        # shared builder drops the data axis from the harness reduction
+        # (the scatter IS it) and OR-reduces the overflow flag over the
+        # model/pipe axes like the reference's model-parallel GradScaler.
+        from apex_tpu.transformer.amp import build_zero_train_step
 
-    @jax.jit
-    def train_step(params, opt_state, tokens, targets):
-        scaled_loss, scaled_grads = shard_fn(
-            params, tokens, targets, opt_state.scaler.loss_scale)
-        new_params, new_state, metrics = mp_opt.apply_gradients(
-            opt_state, params, scaled_grads)
-        return new_params, new_state, scaled_loss / opt_state.scaler.loss_scale, metrics
+        opt_state, state_specs = mp_opt.zero_init(params, mesh, specs)
+        train_step = build_zero_train_step(
+            mp_opt, mesh, specs, state_specs, pipe_loss,
+            rest_specs=rest_specs, grad_axes=grad_axes,
+            data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA)
+    else:
+        opt_state = mp_opt.init(params)
+        shard_fn = jax.shard_map(
+            sharded_grads, mesh=mesh,
+            in_specs=(specs, data_spec, data_spec, P()),
+            out_specs=(P(), specs), check_vma=False,
+        )
+
+        @jax.jit
+        def train_step(params, opt_state, tokens, targets):
+            scaled_loss, scaled_grads = shard_fn(
+                params, tokens, targets, opt_state.scaler.loss_scale)
+            new_params, new_state, metrics = mp_opt.apply_gradients(
+                opt_state, params, scaled_grads)
+            return new_params, new_state, scaled_loss / opt_state.scaler.loss_scale, metrics
 
     if args.data:
         from apex_tpu.csrc import TokenLoader
@@ -196,7 +224,16 @@ def main():
             args.journal, sample_hbm_every=10,
             meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
                   "dp": dp, "hidden": args.hidden, "layers": args.layers,
-                  "seq": args.seq, "batch": batch})
+                  "seq": args.seq, "batch": batch, "zero": bool(args.zero)})
+        try:
+            # per-rank optimizer-state footprint (monitor/hbm.py): the
+            # ZeRO bytes/rank ÷ dp claim as a journaled number, rolled up
+            # by `python -m apex_tpu.monitor.report`
+            from apex_tpu.monitor.hbm import opt_state_bytes
+
+            journal.set_opt_state_bytes(opt_state_bytes(opt_state))
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"opt-state-bytes arming failed: {e}")
         # diagnostics engine (monitor/diagnose.py): overflow/loss-spike
         # forensics keyed off the per-group grad norms above, plus the
         # shape-churn detector around the jitted step — both host-side

@@ -19,6 +19,7 @@ from apex_tpu.optimizers import (
     FusedLAMB,
     distributed_fused,
     fused_adam,
+    sharded_state_shapes,
     state_specs,
 )
 from apex_tpu.optimizers.distributed import abstract_state
@@ -122,6 +123,58 @@ def test_state_is_sharded(mesh):
     shard_shapes = {s.data.shape for s in state.exp_avg["w"].addressable_shards}
     assert shard_shapes == {(16,)}
     assert state.step.shape == ()
+
+
+def test_chained_transform_wraps_and_shards(mesh):
+    """distributed_fused over a CHAINED inner (fused_adam -> optax.trace):
+    sharded_state_shapes/state_specs must recurse the nested tuple-of-
+    NamedTuple state — chunk leaves (1-D) sharded, step counters
+    replicated — and the update must match the unsharded chain on the
+    replica-mean gradient."""
+    params = {"w": jax.random.normal(jax.random.PRNGKey(1), (13, 7)),
+              "b": jax.random.normal(jax.random.PRNGKey(2), (5,))}
+    g = jax.tree.map(
+        lambda p: jax.random.normal(jax.random.PRNGKey(3), p.shape), params)
+
+    def make_inner():
+        return optax.chain(fused_adam(lr=1e-2), optax.trace(decay=0.9))
+
+    tx = distributed_fused(make_inner(), axis="data")
+    pspec = jax.tree.map(lambda _: P(), params)
+
+    # nested abstract state: (FusedAdamState, TraceState) per device
+    shapes = sharded_state_shapes(make_inner(), params, N)
+    assert isinstance(shapes, tuple) and len(shapes) == 2
+    assert shapes[0].exp_avg["w"].shape == (96 // N,)  # 91 -> 96 padded
+    assert shapes[1].trace["w"].shape == (96 // N,)
+    sspecs = state_specs(shapes, "data")
+    assert sspecs[0].step == P()
+    assert sspecs[0].exp_avg["w"] == P("data")
+    assert sspecs[1].trace["b"] == P("data")
+
+    def run(p, g):
+        state = tx.init(p)
+        for _ in range(2):
+            upd, state = tx.update(g, state, p)
+            p = optax.apply_updates(p, upd)
+        return p, state
+
+    got, state = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(pspec, pspec),
+        out_specs=(pspec, sspecs), check_vma=False))(params, g)
+    # the trace (momentum) leaves really are sharded 1/N per device
+    assert {s.data.shape for s in state[1].trace["w"].addressable_shards} \
+        == {(96 // N,)}
+
+    ref_tx = make_inner()
+    want, ref_state = params, ref_tx.init(params)
+    for _ in range(2):
+        upd, ref_state = ref_tx.update(g, ref_state, want)
+        want = optax.apply_updates(want, upd)
+    for name in params:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]),
+            rtol=2e-5, atol=2e-5, err_msg=name)
 
 
 def test_lamb_trust_ratio_matches_across_sharding(mesh):

@@ -510,6 +510,85 @@ def test_sequence_parallel_hazard_on_gpt_models():
 
 
 # ---------------------------------------------------------------------------
+# engine 2: ZeRO-redundancy tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_zero_redundancy_flags_bulk_data_psum():
+    def double_reduced(g):
+        return lax.psum(g, "data") * 2.0  # full-size grad all-reduce
+
+    hz = trace.zero_redundancy_hazards(
+        double_reduced, jnp.ones((64, 128)), axes={"data": 8})
+    assert hz["hazard"] and hz["bulk_psums"] == 1
+    assert hz["findings"][0]["rule"] == "zero-redundancy"
+    assert "psum_scatter" in hz["findings"][0]["message"]
+
+
+def test_zero_redundancy_passes_decomposed_and_scalar():
+    """The optimizer's scatter/gather conjugates pass; scalar collectives
+    (loss pmean, found_inf pmax, LAMB norm psums) are exempt — reported
+    under census['other'] — and the bulk census shows the decomposition
+    (the gather is bulk by its RESULT: the per-rank operand is the small
+    chunk, the output is the full param)."""
+    from apex_tpu.optimizers.distributed import gather_leaf, scatter_chunk
+    from apex_tpu.parallel.collectives import ZERO_DECOMPOSED_PRIMS
+
+    def decomposed(g):
+        chunk = scatter_chunk(g, 8, "data") / 8
+        full = gather_leaf(chunk, g.shape, g.dtype, "data",
+                           gather_dtype=jnp.bfloat16)
+        loss = lax.pmean(jnp.sum(full), "data")
+        bad = lax.pmax(jnp.float32(0.0), "data")
+        norm = lax.psum(jnp.sum(chunk * chunk), "data")
+        return loss + bad + norm
+
+    hz = trace.zero_redundancy_hazards(
+        decomposed, jnp.ones((64, 128)), axes={"data": 8})
+    assert not hz["hazard"], hz
+    assert set(hz["census"]["bulk"]) == set(ZERO_DECOMPOSED_PRIMS)
+    assert hz["census"]["other"].get("pmax") == 1
+    assert hz["census"]["other"].get("psum") >= 1  # the norm + loss pmean
+
+
+def test_zero_redundancy_on_real_mixed_precision_step():
+    """The actual ZeRO amp step (MixedPrecisionOptimizer(zero_axis=...))
+    traces clean; the replicated harness pattern (allreduce_gradients on
+    the data axis) is exactly the flagged regression."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.parallel.distributed import allreduce_gradients
+
+    policy = amp.get_policy("O2")
+    params = {"w": jnp.ones((64, 64), jnp.bfloat16)}
+    grads = {"w": jnp.ones((64, 64), jnp.float32)}
+
+    z = amp.MixedPrecisionOptimizer(
+        FusedLAMB(lr=1e-2, norm_psum_axis="data"), policy,
+        zero_axis="data", gather_dtype="bf16", log_grad_norm=True)
+
+    def zero_step(p, g):
+        st = z.init(p)
+        return z.apply_gradients(st, p, g)[0]
+
+    hz = trace.zero_redundancy_hazards(zero_step, params, grads,
+                                       axes={"data": 8})
+    assert not hz["hazard"], hz
+    assert hz["census"]["bulk"].get("reduce_scatter") == 1
+
+    ref = amp.MixedPrecisionOptimizer(FusedLAMB(lr=1e-2), policy)
+
+    def replicated_step(p, g):
+        st = ref.init(p)
+        return ref.apply_gradients(
+            st, p, allreduce_gradients(g, ("data",)))[0]
+
+    hz = trace.zero_redundancy_hazards(replicated_step, params, grads,
+                                       axes={"data": 8})
+    assert hz["hazard"] and hz["bulk_psums"] >= 1
+
+
+# ---------------------------------------------------------------------------
 # engine 2: recompile-hazard scanner
 # ---------------------------------------------------------------------------
 

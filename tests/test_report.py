@@ -365,6 +365,24 @@ def test_report_loss_ignores_scaled_nan_free_floats(tmp_path):
     assert a["loss"]["nonfinite_count"] == 1
 
 
+def test_report_rolls_up_opt_state_bytes(tmp_path):
+    """Journals armed with set_opt_state_bytes (the ZeRO bytes/rank ÷ dp
+    claim) roll up into analyze() and the rendered view."""
+    import io
+
+    path = tmp_path / "zero.jsonl"
+    with MetricsJournal(str(path)) as j:
+        j.set_opt_state_bytes(512 << 20)
+        for step in range(3):
+            j.step_end(step=step, loss=jnp.float32(2.0), tokens=1024,
+                       wall_s=0.1)
+    a = report.analyze(MetricsJournal.read(path))
+    assert a["opt_state_bytes"] == {"last": 512 << 20, "peak": 512 << 20}
+    buf = io.StringIO()
+    report.render(a, file=buf)
+    assert "opt state: 536.9 MB/rank" in buf.getvalue()
+
+
 def test_percentile_helper():
     assert report._percentile([1.0], 0.5) == 1.0
     assert report._percentile([1.0, 2.0, 3.0, 4.0], 0.5) == 2.5
