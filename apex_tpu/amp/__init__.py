@@ -9,6 +9,7 @@ from apex_tpu.amp.frontend import (  # noqa: F401
     AmpTrainState,
     MixedPrecisionOptimizer,
     MPOptState,
+    Zero3Setup,
     initialize,
 )
 from apex_tpu.amp.functions import (  # noqa: F401

@@ -55,6 +55,31 @@ class MPOptState(NamedTuple):
     scaler: LossScaler
 
 
+class Zero3Setup(NamedTuple):
+    """Host-side wiring bundle for fully-sharded (ZeRO-3) training, built
+    by :meth:`MixedPrecisionOptimizer.zero3_init`.
+
+    ``params`` is the persistent working-param CHUNK tree (each leaf this
+    rank's 1/n slice, in the model dtype): the bf16 params are never
+    materialized whole — layers all-gather just-in-time inside the layer
+    loop (models/_transformer.run_layers ``chunk_meta``) and free after
+    use. ``param_specs``/``state_specs`` are the shard_map in/out specs for
+    the chunk trees; ``meta`` (optimizers.distributed.ChunkedMeta) carries
+    the static local full shapes the JIT gathers rebuild."""
+
+    params: Any
+    param_specs: Any
+    opt_state: Any
+    state_specs: Any
+    meta: Any
+
+
+def _spec_axis_names(entry):
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+
+
 def _canon_gather_dtype(dt):
     if dt is None:
         return None
@@ -97,7 +122,9 @@ class MixedPrecisionOptimizer:
         log_grad_norm: bool = False,
         log_group_norms: bool = False,
         zero_axis: Optional[str] = None,
+        zero_level: int = 2,
         gather_dtype: Optional[Any] = None,
+        stacked_keys: Tuple[str, ...] = ("layers",),
         **scaler_kwargs,
     ):
         self.inner = (
@@ -113,6 +140,27 @@ class MixedPrecisionOptimizer:
         #: every param REPLICATED over the axis (dense models; data-sharded
         #: params like MoE experts cannot be chunked over their own axis).
         self.zero_axis = zero_axis
+        #: ZeRO stage under ``zero_axis``. 1/2 (one implementation here:
+        #: masters AND moments always shard together) keep the bf16 working
+        #: params replicated and all-gather them after every update. 3
+        #: shards the *model* too: the working params persist as chunk
+        #: trees (see :meth:`zero3_init`), each layer's weights are
+        #: all-gathered just-in-time inside the layer loop (and re-gathered
+        #: in backward via per-layer remat), grads arrive as per-layer
+        #: reduce-scattered chunks (the JIT gather's AD transpose), and
+        #: ``apply_gradients`` skips the post-update bulk gather entirely —
+        #: the updated chunks ARE the persistent state.
+        self.zero_level = int(zero_level)
+        if self.zero_level not in (1, 2, 3):
+            raise ValueError(f"zero_level must be 1, 2 or 3, got {zero_level}")
+        if self.zero_level >= 3 and zero_axis is None:
+            raise ValueError("zero_level=3 requires zero_axis (the mesh axis "
+                             "the params shard over)")
+        #: top-level param-dict keys holding scan-stacked layer trees
+        #: (leading num_layers dim): under ``zero_level=3`` these chunk
+        #: PER ROW — ``(L, ...) -> (L, k)`` — so one layer gathers at a
+        #: time (optimizers.distributed.local_chunk_stacked).
+        self.stacked_keys = tuple(stacked_keys)
         #: wire dtype of the updated-param all-gather under ``zero_axis``
         #: (the reference's e5m2-compressed allgather knob,
         #: distributed_fused_adam.py:64): "bf16" halves the gather bytes.
@@ -143,6 +191,44 @@ class MixedPrecisionOptimizer:
         self._zero_norm_axes = None
         self._scaler_kwargs = scaler_kwargs
 
+    def _stacked_tree(self, params) -> Any:
+        """Bool tree: True on leaves under a ``stacked_keys`` top-level
+        entry (scan-stacked layer params) — only consulted at
+        ``zero_level=3``, where those leaves chunk per row."""
+        if self.zero_level < 3 or not isinstance(params, dict):
+            return jax.tree.map(lambda _: False, params)
+        return {k: jax.tree.map(lambda _: k in self.stacked_keys, v)
+                for k, v in params.items()}
+
+    def _chunk_tree(self, params, dtype=None):
+        """This rank's chunk of every leaf (stacked-aware at level 3).
+        Must run inside shard_map (or an axis_env trace) binding the
+        zero axis."""
+        from apex_tpu.optimizers.distributed import (
+            local_chunk,
+            local_chunk_stacked,
+        )
+
+        n = lax.axis_size(self.zero_axis)
+        idx = lax.axis_index(self.zero_axis)
+
+        def chunk(p, st):
+            if dtype is not None:
+                p = p.astype(dtype)
+            return (local_chunk_stacked if st else local_chunk)(p, n, idx)
+
+        return jax.tree.map(chunk, params, self._stacked_tree(params))
+
+    def zero3_shard(self, model_params) -> Any:
+        """The persistent ZeRO-3 working-param chunk tree (model dtypes):
+        stacked layer leaves become ``(L, k)`` per-row chunks, everything
+        else a 1-D chunk. Traced counterpart of :meth:`zero3_init`'s
+        placement — also usable directly under an ``axis_env`` trace
+        (the evidence censuses)."""
+        if self.zero_level < 3:
+            raise ValueError("zero3_shard requires zero_level=3")
+        return self._chunk_tree(model_params)
+
     def init(self, model_params) -> MPOptState:
         if self.zero_axis is not None:
             # ZeRO: keep only this rank's fp32 chunk of every leaf — the
@@ -150,14 +236,10 @@ class MixedPrecisionOptimizer:
             # policy.master_weights: without them the sharded update could
             # not be applied without re-gathering params first). Must run
             # inside shard_map binding the axis (zero_init wraps this).
-            from apex_tpu.optimizers.distributed import local_chunk
-
-            n = lax.axis_size(self.zero_axis)
-            idx = lax.axis_index(self.zero_axis)
-            master = jax.tree.map(
-                lambda p: local_chunk(p.astype(jnp.float32), n, idx),
-                model_params,
-            )
+            # At zero_level=3 the masters mirror the working-param chunk
+            # layout (per-row chunks for stacked layer leaves) so the
+            # sharded update consumes the per-layer-scattered grads as-is.
+            master = self._chunk_tree(model_params, dtype=jnp.float32)
             return MPOptState(
                 inner=self.inner.init(master),
                 master=master,
@@ -201,6 +283,14 @@ class MixedPrecisionOptimizer:
         overflow flag is pmax'd over the zero axis internally so the
         sharded state stays bit-identical on every rank through a skipped
         step; pass ``found_inf_reducer`` for the model/pipe axes as usual.
+
+        Under ``zero_level=3`` both ``model_params`` and ``scaled_grads``
+        are CHUNK trees: the grads arrive already reduce-scattered over
+        the zero axis (each JIT layer gather's AD transpose is a per-layer
+        psum_scatter — sum semantics, so the 1/n averaging still happens
+        here), the sharded update runs directly on the chunks, and no
+        gather follows: the new bf16 chunks (cast from the stepped
+        masters) ARE the returned model params.
         """
         grads32, found_inf = state.scaler.unscale(scaled_grads, out_dtype=jnp.float32)
         if self.zero_axis is not None:
@@ -214,6 +304,9 @@ class MixedPrecisionOptimizer:
             found_inf = found_inf_reducer(found_inf)
 
         if self.zero_axis is not None:
+            if self.zero_level >= 3:
+                return self._apply_zero3(
+                    state, model_params, grads32, found_inf, update_kwargs)
             return self._apply_zero(
                 state, model_params, grads32, found_inf, update_kwargs)
 
@@ -313,6 +406,51 @@ class MixedPrecisionOptimizer:
                 extra_axes=self._zero_norm_axes)
         return new_model, MPOptState(new_inner, new_master, new_scaler), metrics
 
+    # -- the ZeRO-3 step: no scatter (grads arrive as chunks), no gather ----
+    def _apply_zero3(self, state, param_chunks, grads32, found_inf,
+                     update_kwargs):
+        """Fully-sharded step: the grads were reduce-scattered layer by
+        layer in the backward (gather transposes), so the update is pure
+        per-chunk arithmetic — inner step on the fp32 master chunks,
+        overflow select back to the old chunks (axis-consistent, so a
+        skipped step leaves every rank's shard bit-identical), then the
+        new working params are the bf16-cast of the new masters. Zero
+        collectives: the PR-5 bulk post-update all-gather is gone —
+        updated chunks are already the persistent state."""
+        axis = self.zero_axis
+        n = lax.axis_size(axis)
+        # the gather transposes SUMMED over the axis; /n is the same
+        # averaging factor allreduce_gradients applies
+        g_chunks = jax.tree.map(lambda g: g / n, grads32)
+
+        updates, stepped_inner = self.inner.update(
+            g_chunks, state.inner, state.master, **update_kwargs)
+        stepped_master = optax.apply_updates(state.master, updates)
+        keep = lambda new, old: jax.tree.map(  # noqa: E731
+            lambda a, b: jnp.where(found_inf, b, a), new, old)
+        new_master = keep(stepped_master, state.master)
+        new_inner = keep(stepped_inner, state.inner)
+
+        # master -> model copy-out in the model dtypes, chunk for chunk
+        new_params = jax.tree.map(
+            lambda m, c: m.astype(c.dtype), new_master, param_chunks)
+
+        new_scaler = state.scaler.update(found_inf)
+        metrics = {
+            "found_inf": found_inf,
+            "loss_scale": new_scaler.loss_scale,
+        }
+        if self.log_grad_norm:
+            metrics["grad_norm"] = jnp.sqrt(sharded_tree_sumsq(
+                g_chunks, axis, self._zero_norm_axes))
+        if self.log_group_norms:
+            from apex_tpu.monitor.diagnose import group_grad_norms
+
+            metrics["grad_norm_by_group"] = group_grad_norms(
+                g_chunks, psum_axis=axis,
+                extra_axes=self._zero_norm_axes)
+        return new_params, MPOptState(new_inner, new_master, new_scaler), metrics
+
     # -- ZeRO wiring helpers (host side) ------------------------------------
     def zero_abstract_state(self, model_params, mesh, param_specs=None):
         """Per-device ShapeDtypeStruct tree of the ZeRO :class:`MPOptState`.
@@ -400,12 +538,200 @@ class MixedPrecisionOptimizer:
         through the train step's shard_map in/out specs. ``param_specs``
         is the params' PartitionSpec tree (the same one the step uses).
         """
+        if self.zero_level >= 3:
+            raise ValueError("zero_level=3 shards the params themselves; "
+                             "wire with zero3_init (returns the chunked "
+                             "param tree + specs + gather metadata)")
         abstract = self.zero_abstract_state(model_params, mesh, param_specs)
         sspecs = self.zero_state_specs(abstract, mesh)
         init = jax.jit(jax.shard_map(
             self.init, mesh=mesh, in_specs=(param_specs,),
             out_specs=sspecs, check_vma=False))
         return init(model_params), sspecs
+
+    # -- ZeRO-3 wiring (host side) ------------------------------------------
+    def _zero3_local_shapes(self, model_params, mesh, param_specs):
+        """Per-leaf LOCAL (per-device) full shapes: each dim divided by the
+        sizes of the mesh axes its PartitionSpec shards it over — what a
+        JIT gather must rebuild inside shard_map. Also validates that no
+        param is sharded over the zero axis (the level-1/2 constraint,
+        unchanged) and records ``_zero_norm_axes``."""
+        leaves, treedef = jax.tree.flatten(model_params)
+        if param_specs is None:
+            spec_leaves = [None] * len(leaves)
+        else:
+            spec_leaves = jax.tree.leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, P))
+            if len(spec_leaves) != len(leaves):
+                raise ValueError(
+                    f"param_specs tree has {len(spec_leaves)} specs for "
+                    f"{len(leaves)} params")
+
+        def local_shape(p, spec):
+            shape = [int(d) for d in p.shape]
+            for d, entry in enumerate(spec or ()):
+                for ax in _spec_axis_names(entry):
+                    if ax == self.zero_axis:
+                        raise ValueError(
+                            f"param of shape {tuple(p.shape)} is SHARDED "
+                            f"over the zero axis {self.zero_axis!r} — ZeRO "
+                            f"chunks require every param replicated over "
+                            f"it (dense models; reduce MoE-style "
+                            f"data-sharded groups separately)")
+                    if mesh is not None:
+                        shape[d] //= mesh.shape[ax]
+            return tuple(shape)
+
+        def sharded_axes(spec):
+            out = []
+            for entry in (spec or ()):
+                for ax in _spec_axis_names(entry):
+                    if ax not in out:
+                        out.append(ax)
+            return tuple(out)
+
+        self._zero_norm_axes = treedef.unflatten(
+            [sharded_axes(s) for s in spec_leaves])
+        shapes = treedef.unflatten(
+            [local_shape(p, s) for p, s in zip(leaves, spec_leaves)])
+        return shapes, treedef, spec_leaves
+
+    def zero3_meta(self, model_params, mesh=None, param_specs=None):
+        """The static gather metadata (optimizers.distributed.ChunkedMeta)
+        for a ZeRO-3 chunk tree of ``model_params``: per-leaf LOCAL full
+        ``ShapeDtypeStruct``s — the per-LAYER row shape for stacked layer
+        leaves — plus the axis and wire dtype. Without ``mesh`` the global
+        shapes are used (axis_env traces, serial censuses)."""
+        shapes, treedef, _ = self._zero3_local_shapes(
+            model_params, mesh, param_specs)
+        return self._zero3_meta_from(
+            model_params, shapes, self._stacked_tree(model_params))
+
+    def _zero3_meta_from(self, model_params, shapes, stacked):
+        """ChunkedMeta from precomputed local shapes (one traversal:
+        zero3_init already holds them)."""
+        from apex_tpu.optimizers.distributed import ChunkedMeta
+
+        def struct(p, ls, st):
+            return jax.ShapeDtypeStruct(tuple(ls[1:]) if st else tuple(ls),
+                                        p.dtype)
+
+        return ChunkedMeta(
+            shapes=jax.tree.map(struct, model_params, shapes, stacked),
+            axis=self.zero_axis,
+            gather_dtype=self.gather_dtype)
+
+    def zero3_init(self, model_params, mesh, param_specs) -> Zero3Setup:
+        """Initialize fully-sharded training state from host-side (global)
+        params: places the working-param chunk tree, the fp32 master
+        chunks + inner optimizer state (same per-row layout), and returns
+        the :class:`Zero3Setup` bundle the train-step builder consumes
+        (transformer.amp.build_zero_train_step). The chunk specs carry no
+        replication assumption over ANY axis — stacked leaves shard their
+        leading (layer) dim exactly as the param spec does (the pipeline
+        axis), their chunk dim over everything else — so TP/pipe-sharded
+        params round-trip correctly."""
+        from apex_tpu.optimizers.distributed import chunk_size
+
+        if self.zero_level < 3:
+            raise ValueError("zero3_init requires zero_level=3")
+        n = mesh.shape[self.zero_axis]
+        shapes, treedef, spec_leaves = self._zero3_local_shapes(
+            model_params, mesh, param_specs)
+        stacked = self._stacked_tree(model_params)
+        meta = self._zero3_meta_from(model_params, shapes, stacked)
+
+        def prod(xs):
+            size = 1
+            for s in xs:
+                size *= s
+            return size
+
+        def chunk_struct(p, ls, st, dtype):
+            if st:
+                return jax.ShapeDtypeStruct(
+                    (ls[0], chunk_size(prod(ls[1:]), n)), dtype)
+            return jax.ShapeDtypeStruct((chunk_size(prod(ls), n),), dtype)
+
+        master_structs = jax.tree.map(
+            lambda p, ls, st: chunk_struct(p, ls, st, jnp.float32),
+            model_params, shapes, stacked)
+
+        universal = P(tuple(mesh.axis_names))
+
+        def chunk_spec(spec, st):
+            if not st:
+                return universal
+            dim0 = spec[0] if spec is not None and len(spec) else None
+            d0_axes = _spec_axis_names(dim0)
+            rest = tuple(a for a in mesh.axis_names if a not in d0_axes)
+            return P(dim0, rest)
+
+        st_leaves = [bool(s) for s in jax.tree.leaves(stacked)]
+        chunk_specs = treedef.unflatten(
+            [chunk_spec(s, st) for s, st in zip(spec_leaves, st_leaves)])
+        stacked_specs = {chunk_spec(s, True) for s, st
+                         in zip(spec_leaves, st_leaves) if st}
+        if len(stacked_specs) > 1:
+            raise ValueError(
+                f"stacked layer leaves carry inconsistent leading-dim "
+                f"specs {sorted(map(str, stacked_specs))}: the sharded "
+                f"optimizer-state specs need one uniform (L, chunk) "
+                f"placement")
+        stacked_spec = (stacked_specs.pop() if stacked_specs
+                        else P(None, tuple(mesh.axis_names)))
+
+        scaler = _scaler_from_policy(self.policy, **self._scaler_kwargs)
+        abstract_state = jax.eval_shape(
+            lambda m: MPOptState(inner=self.inner.init(m), master=m,
+                                 scaler=scaler),
+            master_structs)
+        # chunks are 1-D (or (L, chunk) for stacked leaves) BY CONSTRUCTION,
+        # so rank alone classifies state leaves: scalars (step counters, the
+        # scaler) replicate, everything else is a per-device shard
+        state_specs = jax.tree.map(
+            lambda x: (stacked_spec if getattr(x, "ndim", 0) == 2
+                       else universal if getattr(x, "ndim", 0) == 1
+                       else P()),
+            abstract_state)
+
+        init = jax.jit(jax.shard_map(
+            lambda p: (self.zero3_shard(p), self.init(p)),
+            mesh=mesh, in_specs=(param_specs,),
+            out_specs=(chunk_specs, state_specs), check_vma=False))
+        chunks, state = init(model_params)
+        return Zero3Setup(params=chunks, param_specs=chunk_specs,
+                          opt_state=state, state_specs=state_specs,
+                          meta=meta)
+
+    def zero3_materialize(self, setup: Zero3Setup, mesh, param_specs,
+                          param_chunks=None):
+        """Gather the full (global) params back from a chunk tree — for
+        checkpointed-weight export, eval harnesses, and the equivalence
+        tests. Host-side helper (one jitted shard_map); the TRAIN path
+        never calls this — materializing the whole model is exactly what
+        ZeRO-3 removes. Wire dtype is each leaf's own (exact round-trip)."""
+        from apex_tpu.optimizers.distributed import (
+            gather_leaf,
+            gather_stacked_leaf,
+        )
+
+        chunks = setup.params if param_chunks is None else param_chunks
+        stacked = self._stacked_tree(chunks)
+        meta = setup.meta
+
+        def gather_all(c_tree):
+            return jax.tree.map(
+                lambda c, s, st: (
+                    gather_stacked_leaf(c, s.shape, s.dtype, self.zero_axis)
+                    if st else
+                    gather_leaf(c, s.shape, s.dtype, self.zero_axis)),
+                c_tree, meta.shapes, stacked)
+
+        fn = jax.jit(jax.shard_map(
+            gather_all, mesh=mesh, in_specs=(setup.param_specs,),
+            out_specs=param_specs, check_vma=False))
+        return fn(chunks)
 
     # -- checkpointing (apex/amp/frontend.py:361-400) -----------------------
     def state_dict(self, state: MPOptState):

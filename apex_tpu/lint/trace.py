@@ -31,6 +31,11 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   (``MixedPrecisionOptimizer(zero_axis=...)``): the optimizer's
   psum_scatter IS that reduction, so the surviving all-reduce silently
   double-counts the averaging; same tripwire shape as ``sp-regression``.
+- ``zero3-bulk-gather`` (:func:`zero3_gather_hazards`) -- a MODEL-SIZED
+  ``all_gather`` result on the zero axis in a fully-sharded (ZeRO-3) step:
+  params must stay 1/n chunks gathered just-in-time per layer
+  (models/_transformer.run_layers ``chunk_meta``); a whole-stack or
+  post-update bulk gather silently returns peak HBM to O(model).
 
 All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
 device work) and return plain dicts/lists of findings shaped like engine
@@ -475,6 +480,112 @@ def zero_redundancy_hazards(fn, *args,
         "hazard": bool(n_psum),
         "census": census,
         "bulk_psums": n_psum,
+        "findings": findings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-3 bulk-gather tripwire
+# ---------------------------------------------------------------------------
+
+
+def param_gather_census(jaxpr, zero_axis: str,
+                        min_model_elems: int) -> Dict[str, Any]:
+    """Census of ``all_gather`` equations over ``zero_axis``, classified by
+    RESULT size (the same result-sized rule as :func:`zero_collective_census`
+    — a gather's operand is the small 1/n chunk, its result the materialized
+    param): results with >= ``min_model_elems`` elements are MODEL-SIZED
+    bulk gathers (a whole layer stack or the PR-5 post-update param
+    gather), everything below is a per-layer/per-leaf JIT gather. Counts
+    are call sites per trace (a gather inside ``lax.scan`` counts once,
+    like the comm accounting)."""
+    per_layer: Counter = Counter()
+    bulk: Counter = Counter()
+    bulk_sites: List[Dict[str, Any]] = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "all_gather":
+            continue
+        if zero_axis not in _eqn_axis_names(eqn):
+            continue
+        out_sizes = [int(getattr(_aval_of(v), "size", 0) or 0)
+                     for v in eqn.outvars if _aval_of(v) is not None]
+        result = max(out_sizes, default=0)
+        if result >= min_model_elems:
+            bulk["all_gather"] += 1
+            aval = _aval_of(eqn.outvars[0])
+            bulk_sites.append({
+                "result_shape": [int(d) for d in
+                                 getattr(aval, "shape", ()) or ()],
+                "result_elems": result,
+                "dtype": str(getattr(aval, "dtype", "")),
+            })
+        else:
+            per_layer["all_gather"] += 1
+    return {"per_layer": dict(per_layer), "bulk": dict(bulk),
+            "bulk_sites": bulk_sites}
+
+
+def zero3_gather_hazards(fn, *args,
+                         zero_axis: str = "data",
+                         axes: Optional[Dict[str, int]] = None,
+                         model_elems: Optional[int] = None,
+                         bulk_fraction: float = 0.25,
+                         min_model_elems: Optional[int] = None,
+                         **kwargs) -> Dict[str, Any]:
+    """Verify a ZeRO-3 (fully-sharded-param) train step gathers its weights
+    PER LAYER, never whole-model.
+
+    Traces ``fn(*args)`` under ``axes`` (omit when ``fn`` binds its own
+    axes via shard_map) and censuses ``all_gather`` results on
+    ``zero_axis``. Under ``MixedPrecisionOptimizer(zero_level=3)`` the bf16
+    params persist as 1/n chunks and each layer's weight tree is gathered
+    just-in-time inside the layer loop (models/_transformer.run_layers
+    ``chunk_meta``), so every gather result is one layer's params — a
+    MODEL-SIZED gather result (the whole stacked-layer leaf, or the PR-5
+    post-update bulk param gather) means a refactor silently rematerialized
+    the replicated model that ZeRO-3 exists to remove; peak HBM returns to
+    O(model) and XLA compiles it without complaint.
+
+    The model-sized threshold is ``min_model_elems`` when given, else
+    ``bulk_fraction * model_elems`` (pass ``model_elems`` = the total
+    param count; one layer of an L-layer stack sits at ~1/L of it, far
+    below a 0.25 fraction, while a whole-stack gather is most of the
+    model), else a 4Mi-element default.
+
+    Returns ``{hazard, census, bulk_gathers, layer_gathers, findings}`` —
+    call-site counts per trace, like :func:`zero_redundancy_hazards`.
+    """
+    import jax
+
+    if min_model_elems is None:
+        min_model_elems = (max(int(bulk_fraction * model_elems), 1)
+                           if model_elems else 1 << 22)
+    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
+        jaxpr = fn.jaxpr
+    else:
+        env = list(axes.items()) if axes else None
+        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    census = param_gather_census(jaxpr, zero_axis, min_model_elems)
+    n_bulk = sum(census["bulk"].values())
+    findings = []
+    if n_bulk:
+        findings.append({
+            "rule": "zero3-bulk-gather",
+            "message": (
+                f"step jaxpr carries {n_bulk} model-sized all_gather "
+                f"result(s) on the '{zero_axis}' axis in a fully-sharded "
+                f"(ZeRO-3) step -- the bf16 params must stay 1/n chunks "
+                f"with per-layer just-in-time gathers (run_layers "
+                f"chunk_meta); a bulk gather rematerializes the replicated "
+                f"model and peak HBM returns to O(model)"),
+            "verb": "all_gather", "extra": n_bulk,
+        })
+    return {
+        "hazard": bool(n_bulk),
+        "census": census,
+        "bulk_gathers": n_bulk,
+        "layer_gathers": sum(census["per_layer"].values()),
+        "min_model_elems": int(min_model_elems),
         "findings": findings,
     }
 

@@ -412,11 +412,25 @@ class TransformerBase:
         attn_bias: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
         return_aux: bool = False,
+        chunk_meta=None,
     ):
         """Scan the (stacked) layer params over the hidden state. ``layers``
         may be any contiguous slice of the stack — a pipeline stage's chunk.
         Activation checkpointing is ``jax.checkpoint`` on the scanned body
         (reference: tensor_parallel/random.py:224-294 CheckpointFunction).
+
+        ``chunk_meta`` (optimizers.distributed.ChunkedMeta, per-LAYER local
+        shapes) switches to the ZeRO-3 fully-sharded drive: ``layers`` is
+        then a ``(L, k)`` per-row chunk stack and each layer's full weight
+        tree is all-gathered JUST IN TIME inside the body — so peak param
+        residency is one layer plus chunks, not the whole stack. The body
+        is always rematerialized in this mode (even with ``cfg.remat``
+        off): backward then RE-GATHERS each layer instead of saving the
+        gathered weights as residuals, and the gather's AD transpose
+        reduce-scatters that layer's grads on the spot. On the unrolled
+        path the per-layer gathers are static, independent collectives —
+        the prefetch schedule XLA's latency-hiding scheduler can hoist
+        (gather layer i+1 while layer i computes).
 
         When the model's layers emit aux losses (``_aux_init`` not None),
         they accumulate in the scan carry and the caller MUST pass
@@ -433,10 +447,14 @@ class TransformerBase:
                 "Under the pipeline schedules, pass run_layers with "
                 "return_aux=True plus aux_to_loss to pipelined_loss_fn."
             )
+        if chunk_meta is not None:
+            from apex_tpu.optimizers.distributed import gather_chunked_tree
 
         def body(carry, xs):
             h, acc = carry
             p, k = xs
+            if chunk_meta is not None:
+                p = gather_chunked_tree(p, chunk_meta)
             h, aux = self._layer_aux(p, h, k, attn_bias)
             if acc is not None:
                 acc = jax.tree.map(
@@ -444,7 +462,7 @@ class TransformerBase:
                     jax.tree.map(lambda v: v.astype(jnp.float32), aux))
             return (h, acc), None
 
-        if self.cfg.remat:
+        if self.cfg.remat or chunk_meta is not None:
             body = jax.checkpoint(
                 body, prevent_cse=False,
                 policy=_remat_policy(getattr(self.cfg, "remat_policy", None)),

@@ -254,6 +254,7 @@ class BertModel(TransformerBase):
         tokentype_ids: Optional[jax.Array] = None,
         masked_lm_labels: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
+        layer_chunk_meta=None,
     ):
         if attention_mask is None:
             bias = None
@@ -273,7 +274,10 @@ class BertModel(TransformerBase):
         if dropout_key is not None:
             k_emb, k_layers = jax.random.split(dropout_key)
         h = self.embed(params, tokens, tokentype_ids, k_emb)
-        h = self.run_layers(params["layers"], h, bias, k_layers)
+        # layer_chunk_meta = the ZeRO-3 fully-sharded drive (per-layer JIT
+        # weight gather, models/_transformer.run_layers chunk_meta)
+        h = self.run_layers(params["layers"], h, bias, k_layers,
+                            chunk_meta=layer_chunk_meta)
         return self.head(params, h, masked_lm_labels)
 
     def loss(
@@ -286,6 +290,7 @@ class BertModel(TransformerBase):
         nsp_labels: Optional[jax.Array] = None,
         tokentype_ids: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
+        layer_chunk_meta=None,
     ) -> jax.Array:
         """lm_loss averaged over masked positions (+ NSP CE), the bert
         fwd_step contract (run_bert_minimal_test.py loss_func).
@@ -299,7 +304,8 @@ class BertModel(TransformerBase):
         c = self.cfg
         lm_loss, binary_logits = self.apply(
             params, tokens, attention_mask, tokentype_ids,
-            masked_lm_labels, dropout_key)
+            masked_lm_labels, dropout_key,
+            layer_chunk_meta=layer_chunk_meta)
         w = loss_mask.astype(jnp.float32)
         local = jnp.sum(lm_loss * w)
         if c.context_axis is not None:

@@ -313,10 +313,17 @@ class GPTModel(TransformerBase):
         tokens: jax.Array,
         targets: Optional[jax.Array] = None,
         dropout_key: Optional[jax.Array] = None,
+        layer_chunk_meta=None,
     ):
+        """``layer_chunk_meta`` drives the ZeRO-3 fully-sharded path:
+        ``params["layers"]`` is then a per-row chunk stack gathered
+        just-in-time per layer (run_layers ``chunk_meta``); the non-layer
+        params must arrive already gathered (the step wrapper's job —
+        transformer/amp.build_zero_train_step)."""
         h = self.embed(params, tokens)
         h, aux = self.run_layers(params["layers"], h, dropout_key=dropout_key,
-                                 return_aux=True)
+                                 return_aux=True,
+                                 chunk_meta=layer_chunk_meta)
         out = self.head(params, h, targets)
         if aux is not None and targets is not None:
             # fold per-layer-averaged router losses into the per-token loss
@@ -324,7 +331,9 @@ class GPTModel(TransformerBase):
             out = out + self.aux_to_loss(aux).astype(out.dtype)
         return out
 
-    def loss(self, params, tokens, targets, dropout_key=None) -> jax.Array:
+    def loss(self, params, tokens, targets, dropout_key=None,
+             layer_chunk_meta=None) -> jax.Array:
         """Mean per-token loss — the fwd_step_func contract
         (schedules/common.py:196-255 loss reduction)."""
-        return jnp.mean(self.apply(params, tokens, targets, dropout_key))
+        return jnp.mean(self.apply(params, tokens, targets, dropout_key,
+                                   layer_chunk_meta=layer_chunk_meta))

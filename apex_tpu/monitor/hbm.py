@@ -233,6 +233,93 @@ def optimizer_state_report(
     }
 
 
+def param_state_report(
+    params: Any,
+    dp: int,
+    *,
+    state_copies: int = OPTIMIZER_STATE_COPIES,
+    master_itemsize: int = 4,
+) -> Dict[str, Any]:
+    """Replicated vs ZeRO-1/2 vs ZeRO-3 per-rank param+master+moment bytes.
+
+    Extends :func:`optimizer_state_report` to the WORKING params — the last
+    replicated O(model) tensor ZeRO-3 removes. ``params`` is any pytree
+    with shaped leaves (arrays or ShapeDtypeStructs, e.g.
+    ``jax.eval_shape(model.init, key)`` cast to the compute policy, so each
+    leaf's own dtype prices the working copy — bf16 under O2). Columns,
+    all per rank:
+
+    - ``replicated``  — full working params + ``state_copies`` full fp32
+      arrays per param (no ZeRO);
+    - ``zero12``      — full working params + fp32 state as 1-D
+      ``ceil(size/dp)`` chunks (PR-5 ``zero_axis=...``: one
+      implementation, masters and moments always shard together, so
+      ZeRO-1 and ZeRO-2 price identically here);
+    - ``zero3``       — working params AND fp32 state as chunks
+      (``zero_level=3``: the bf16 model persists 1/dp, each layer
+      all-gathered just-in-time inside the layer loop — the transient
+      gather working set is O(1 layer), not priced as residency).
+
+    Chunks are priced as packed linear storage rounded to whole tile
+    granules (the :func:`optimizer_state_report` rule).
+    """
+    import jax
+    import numpy as np
+
+    from apex_tpu.optimizers.distributed import chunk_size
+
+    def tile_granule(itemsize):
+        sublanes = max(_SUBLANE_BYTES // max(int(itemsize), 1), 1)
+        return sublanes * _NUM_LANES
+
+    granule = tile_granule(master_itemsize)
+
+    p_full = p_full_padded = p_chunk = 0
+    o_full = o_full_padded = o_chunk = 0
+    count = n_leaves = 0
+    for leaf in jax.tree.leaves(params):
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()) or ())
+        try:
+            itemsize = int(np.dtype(leaf.dtype).itemsize)
+        except Exception:  # noqa: BLE001 - dtype-less leaves price as bf16
+            itemsize = 2
+        size = 1
+        for d in shape:
+            size *= d
+        k = chunk_size(size, dp)
+        # working chunks round to the granule of THEIR dtype (bf16: 2048
+        # elems), masters/moments to the fp32 granule
+        p_granule = tile_granule(itemsize)
+        p_full += size * itemsize
+        p_full_padded += lane_padded_bytes(shape, itemsize)
+        p_chunk += -(-k // p_granule) * p_granule * itemsize
+        o_full += size * master_itemsize
+        o_full_padded += lane_padded_bytes(shape, master_itemsize)
+        o_chunk += -(-k // granule) * granule * master_itemsize
+        count += size
+        n_leaves += 1
+    o_full *= state_copies
+    o_full_padded *= state_copies
+    o_chunk *= state_copies
+    table = {
+        "replicated": {"param_bytes": p_full, "opt_bytes": o_full,
+                       "total_bytes": p_full + o_full},
+        "zero12": {"param_bytes": p_full, "opt_bytes": o_chunk,
+                   "total_bytes": p_full + o_chunk},
+        "zero3": {"param_bytes": p_chunk, "opt_bytes": o_chunk,
+                  "total_bytes": p_chunk + o_chunk},
+    }
+    return {
+        "dp": dp, "param_count": count, "param_leaves": n_leaves,
+        "state_copies": state_copies, "master_itemsize": master_itemsize,
+        "per_rank": table,
+        "replicated_padded_param_bytes": p_full_padded,
+        "param_ratio": round(p_full / max(p_chunk, 1), 3),
+        "total_ratio": round((p_full + o_full)
+                             / max(p_chunk + o_chunk, 1), 3),
+    }
+
+
 def opt_state_bytes(opt_state: Any) -> int:
     """Per-rank bytes of a (possibly sharded) optimizer-state pytree.
 
@@ -255,6 +342,14 @@ def opt_state_bytes(opt_state: Any) -> int:
         except Exception:  # noqa: BLE001 - abstract/exotic leaves
             continue
     return total
+
+
+def param_bytes(params: Any) -> int:
+    """Per-rank bytes of a (possibly chunk-sharded) working-param pytree —
+    the same addressable-shard accounting as :func:`opt_state_bytes`: a
+    replicated leaf books its full array, a ZeRO-3 chunk leaf its 1/n
+    shard. Host-side only; arms ``MetricsJournal.set_param_bytes``."""
+    return opt_state_bytes(params)
 
 
 class HBMMonitor:

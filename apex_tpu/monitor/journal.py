@@ -139,6 +139,7 @@ class MetricsJournal:
         self.overflows = 0  # cumulative found_inf count (skip counter)
         self._step_costs: Optional[Dict[str, Any]] = None
         self._opt_state_bytes: Optional[int] = None
+        self._param_bytes: Optional[int] = None
         if meta:
             self.log(dict(meta, kind="meta"))
 
@@ -176,6 +177,15 @@ class MetricsJournal:
         value stamped into every subsequent step record so journals from
         replicated and ZeRO runs compare on the claim directly."""
         self._opt_state_bytes = int(nbytes)
+
+    def set_param_bytes(self, nbytes: int) -> None:
+        """Arm a per-record ``param_bytes`` field: the per-rank WORKING
+        param footprint (``monitor.hbm.param_bytes`` of the live tree —
+        1/dp of the replicated number under ``zero_level=3``, where the
+        bf16 params persist as chunk trees). The companion of
+        :meth:`set_opt_state_bytes`, so replicated/ZeRO-1/2/ZeRO-3
+        journals compare on the full residency claim directly."""
+        self._param_bytes = int(nbytes)
 
     # -- rank info (utils/log_util.py's RankInfoFilter, journal-side) -------
     @staticmethod
@@ -283,6 +293,8 @@ class MetricsJournal:
             rec.update(scaler_state(scaler))
         if self._opt_state_bytes is not None:
             rec["opt_state_bytes"] = self._opt_state_bytes
+        if self._param_bytes is not None:
+            rec["param_bytes"] = self._param_bytes
         rec["overflows"] = self.overflows
         rec.update(extra)
         self._n += 1

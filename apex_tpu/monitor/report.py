@@ -240,6 +240,13 @@ def analyze(
         out["opt_state_bytes"] = {"last": int(osb[-1]),
                                   "peak": int(max(osb))}
 
+    # working-param footprint (set_param_bytes — the ZeRO-3 claim: the
+    # bf16 params themselves at 1/dp vs a replicated run)
+    pb = [r["param_bytes"] for r in steps
+          if isinstance(r.get("param_bytes"), (int, float))]
+    if pb:
+        out["param_bytes"] = {"last": int(pb[-1]), "peak": int(max(pb))}
+
     # overflow / forensics / recompile rollups
     overflows = [r["overflows"] for r in steps
                  if isinstance(r.get("overflows"), (int, float))]
@@ -323,6 +330,10 @@ def render(analysis: Dict[str, Any], file=None) -> None:
     if osb:
         p(f"opt state: {osb['last'] / 1e6:.1f} MB/rank "
           f"(peak {osb['peak'] / 1e6:.1f} MB)")
+    pb = analysis.get("param_bytes")
+    if pb:
+        p(f"params: {pb['last'] / 1e6:.1f} MB/rank "
+          f"(peak {pb['peak'] / 1e6:.1f} MB)")
     p(f"overflows: {analysis.get('overflows', 0)}")
     fo = analysis.get("forensics")
     if fo:
@@ -356,7 +367,10 @@ def compare(
     runs share a peak-spec provenance); the per-step overflow rate must
     not more than double past a 1%-of-steps floor; HBM growth must not
     exceed A's by more than ``hbm_slack_bytes``; B must not introduce
-    non-finite losses A did not have.
+    non-finite losses A did not have; the per-rank ``opt_state_bytes``/
+    ``param_bytes`` stamps must not grow past the threshold (a candidate
+    that silently dropped ZeRO/ZeRO-3 re-replicates O(model) state at
+    identical throughput — only these stamps would see it).
     """
     ra, rb = analyze(a), analyze(b)
     checks: List[Dict[str, Any]] = []
@@ -405,6 +419,18 @@ def compare(
           (ra.get("loss") or {}).get("nonfinite_count", 0),
           (rb.get("loss") or {}).get("nonfinite_count", 0),
           worse=lambda va, vb: vb > va)
+    # per-rank residency stamps (set_opt_state_bytes/set_param_bytes):
+    # regression = the static footprint GROWS past the threshold — a
+    # candidate that quietly dropped ZeRO(-3) re-replicates O(model)
+    # state at identical throughput, which no other check would see
+    check("opt_state_bytes_last",
+          (ra.get("opt_state_bytes") or {}).get("last"),
+          (rb.get("opt_state_bytes") or {}).get("last"),
+          worse=lambda va, vb: vb > va * (1.0 + threshold))
+    check("param_bytes_last",
+          (ra.get("param_bytes") or {}).get("last"),
+          (rb.get("param_bytes") or {}).get("last"),
+          worse=lambda va, vb: vb > va * (1.0 + threshold))
     regressed = [c["check"] for c in checks if c["regressed"]]
     return {"threshold": threshold, "checks": checks,
             "regressed": regressed, "ok": not regressed,

@@ -24,7 +24,9 @@ that the monitor pieces stay importable and functional:
    the known hazards — the d=32/(sq,1) lane-padding numbers, the bare
    ``pmean(loss)``-under-grad transpose, python-scalar signature leaks,
    and the ZeRO double-reduction tripwire (a bulk data-axis grad psum
-   alongside a sharded optimizer; the decomposed scatter/gather passes).
+   alongside a sharded optimizer; the decomposed scatter/gather passes),
+   plus the ZeRO-3 bulk-gather tripwire (a model-sized param all_gather
+   in a fully-sharded step; per-layer JIT gathers pass).
 
 Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
 proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
@@ -331,6 +333,28 @@ def _check_lint() -> dict:
                                                axes={"data": 8})
     assert not zr_ok["hazard"], zr_ok
     assert zr_ok["census"]["bulk"].get("reduce_scatter") == 1, zr_ok
+
+    # engine 2, ZeRO-3 tripwire: a whole-stack (model-sized) param gather
+    # in a fully-sharded step is the O(model) rematerialization; per-layer
+    # JIT gathers pass
+    from apex_tpu.optimizers.distributed import gather_stacked_leaf
+
+    L, row = 8, (8, 64)  # 512 elems/layer, 4096 total
+    chunks = jnp.ones((L, 64), jnp.float32)  # (L, k) at n=8
+
+    z3_bad = lint_trace.zero3_gather_hazards(
+        lambda c: gather_stacked_leaf(c, row, jnp.float32, "data"),
+        chunks, axes={"data": 8}, model_elems=L * 512)
+    assert z3_bad["hazard"] and z3_bad["bulk_gathers"] == 1, z3_bad
+
+    def z3_good(c):
+        return jnp.stack([gather_leaf(c[i], row, jnp.float32, "data")
+                          for i in range(L)])
+
+    z3_ok = lint_trace.zero3_gather_hazards(z3_good, chunks,
+                                            axes={"data": 8},
+                                            model_elems=L * 512)
+    assert not z3_ok["hazard"] and z3_ok["layer_gathers"] == L, z3_ok
 
     # engine 2, sequence-parallel tripwire: an activation psum on the TP
     # axis is the regression; the reduce_scatter/all_gather conjugates and

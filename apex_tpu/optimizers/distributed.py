@@ -45,7 +45,7 @@ axis)`` (moment leaves are sharded on the axis; the step scalar replicated).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -125,6 +125,92 @@ def gather_leaf(
 # backward-compat private aliases (pre-ZeRO-frontend spelling)
 _local_chunk = local_chunk
 _scatter_chunk = scatter_chunk
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-3 layer-stacked chunks (params sharded 1/n with per-layer JIT gather)
+# ---------------------------------------------------------------------------
+#
+# A stacked leaf ``(L, ...)`` (the scan-shaped layer stacks of
+# models/_transformer.py) chunks PER ROW into ``(L, k)`` — each row is the
+# ``local_chunk`` of that layer's flattened params — so one layer's weights
+# can be all-gathered just-in-time inside the layer loop while the rest of
+# the model stays sharded (the cross-replica weight sharding of Xu et al.
+# extended from the update to the model itself, ROADMAP item 1). Leading-dim
+# machinery (pipeline stage shards, vpp interleaving, scan/unroll slicing)
+# keeps working on the chunk stack unchanged.
+
+
+def local_chunk_stacked(x: jax.Array, n: int, idx) -> jax.Array:
+    """Per-row 1-D chunks of a stacked leaf: ``(L, ...) -> (L, k)`` where
+    row ``i`` is ``local_chunk(x[i], n, idx)`` (same flatten/pad/slice
+    layout, so per-row gathers and whole-leaf gathers agree exactly)."""
+    L = x.shape[0]
+    flat = x.reshape(L, -1)
+    padded = _padded_size(flat.shape[1], n)
+    if padded != flat.shape[1]:
+        flat = jnp.pad(flat, ((0, 0), (0, padded - flat.shape[1])))
+    k = padded // n
+    return lax.dynamic_slice(flat, (0, idx * k), (L, k))
+
+
+def gather_stacked_leaf(
+    chunk: jax.Array,
+    row_shape,
+    dtype,
+    axis: str,
+    gather_dtype: Optional[Any] = None,
+) -> jax.Array:
+    """All-gather a ``(L, k)`` chunk stack back into ``(L, *row_shape)``.
+
+    The bulk (whole-stack) inverse of :func:`local_chunk_stacked` — used by
+    host-side materialization (checkpointing, eval). The hot path gathers
+    one ROW at a time via :func:`gather_leaf` inside the layer loop; a
+    whole-stack gather in a ZeRO-3 train step is exactly the hazard
+    ``lint.trace.zero3_gather_hazards`` flags."""
+    L = chunk.shape[0]
+    payload = chunk.astype(gather_dtype if gather_dtype is not None else dtype)
+    with _comm("all_gather", axis, payload):
+        full = lax.all_gather(payload, axis, axis=1, tiled=True)
+    n_elems = 1
+    for s in row_shape:
+        n_elems *= s
+    return (full[:, :n_elems]
+            .reshape((L,) + tuple(row_shape)).astype(dtype))
+
+
+class ChunkedMeta(NamedTuple):
+    """Static gather metadata for a ZeRO-3 chunk tree.
+
+    ``shapes`` mirrors the chunk tree: each leaf a ``ShapeDtypeStruct``
+    holding the LOCAL (per-device, TP/pipe-divided) full shape the chunk
+    gathers back to — the per-LAYER row shape for stacked leaves, the whole
+    leaf shape otherwise. ``axis`` is the ZeRO mesh axis; ``gather_dtype``
+    the wire dtype of the JIT gathers (None = each leaf's own dtype)."""
+
+    shapes: Any
+    axis: str
+    gather_dtype: Optional[Any] = None
+
+    def subtree(self, key) -> "ChunkedMeta":
+        return self._replace(shapes=self.shapes[key])
+
+    def select(self, keys) -> "ChunkedMeta":
+        return self._replace(
+            shapes={k: v for k, v in self.shapes.items() if k in keys})
+
+
+def gather_chunked_tree(chunks: Any, meta: ChunkedMeta) -> Any:
+    """Just-in-time all-gather of a (flat-leaf) chunk tree back to full
+    local arrays — one collective per leaf, each at the wire dtype. Under
+    AD the all_gather transposes to a psum_scatter, so the gradient of a
+    gathered param comes back as an ALREADY data-axis-reduced chunk (the
+    per-layer reduce-scatter of the ZeRO-3 step, for free)."""
+    return jax.tree.map(
+        lambda c, s: gather_leaf(c, s.shape, s.dtype, meta.axis,
+                                 gather_dtype=meta.gather_dtype),
+        chunks, meta.shapes,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
 
 
 def distributed_fused(

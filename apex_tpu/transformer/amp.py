@@ -69,6 +69,11 @@ def build_zero_train_step(
     data_spec: PartitionSpec,
     zero_axis: str = AXIS_DATA,
     layer_specs=None,
+    zero3=None,
+    model=None,
+    num_microbatches: Optional[int] = None,
+    virtual_pipeline_size: int = 1,
+    with_aux: bool = False,
 ):
     """One jitted GPT train step with the whole ZeRO update inside a single
     ``shard_map``: backward, spec-aware grad reduction over every
@@ -84,8 +89,24 @@ def build_zero_train_step(
     ``layer_specs`` is given, otherwise uniformly over the non-zero axes.
     ``(specs, state_specs)`` come from ``mp_opt.zero_init``.
 
+    At ``zero_level=3`` (``mp_opt.zero_level``) pass ``zero3`` (the
+    :class:`apex_tpu.amp.Zero3Setup` from ``mp_opt.zero3_init``) plus
+    ``model`` and the pipeline shape (``num_microbatches``, optionally
+    ``virtual_pipeline_size``/``with_aux``) instead of ``pipe_loss``/
+    ``specs`` — the builder then rebuilds the pipelined loss around the
+    fully-sharded drive: non-layer params all-gather once at step entry,
+    each LAYER's weights all-gather just-in-time inside the layer loop
+    (models/_transformer.run_layers ``chunk_meta``; re-gathered in the
+    backward by per-layer remat), the gathers' AD transposes
+    reduce-scatter that layer's grads on the spot, and ``apply_gradients``
+    finishes on chunks with NO post-update gather (tripwire:
+    ``lint.trace.zero3_gather_hazards``). ``rest_specs``/``layer_specs``
+    stay the ORIGINAL param specs — chunk grads reduce spec-aware over the
+    non-zero axes exactly like full grads (only axis names are read).
+
     Returns ``train_step(params, opt_state, tokens, targets) ->
-    (params, opt_state, loss, metrics)`` with the loss unscaled.
+    (params, opt_state, loss, metrics)`` with the loss unscaled; at level
+    3 ``params`` is the persistent chunk tree (``zero3.params``).
     """
     from apex_tpu.parallel import collectives
     from apex_tpu.parallel.distributed import (
@@ -96,33 +117,101 @@ def build_zero_train_step(
     reducer = MeshGradScaler().found_inf_reducer
     nonzero_axes = tuple(a for a in grad_axes if a != zero_axis)
 
-    def zero_step(p, opt_state, toks, tgts):
-        rest = {k: v for k, v in p.items() if k != "layers"}
-
-        def scaled_loss(rest, layers):
-            return pipe_loss(rest, layers, toks, tgts) \
-                * opt_state.scaler.loss_scale
-
-        loss, (rest_g, layer_g) = jax.value_and_grad(
-            scaled_loss, argnums=(0, 1))(rest, p["layers"])
+    def reduce_nonzero(rest_g, layer_g):
+        # nonzero_axes already excludes zero_axis: the sharded optimizer's
+        # psum_scatter (level 2) / the gather transposes (level 3) ARE the
+        # reduction over it
         rest_g = allreduce_gradients_by_spec(
-            rest_g, rest_specs, data_axes=nonzero_axes, zero_axis=zero_axis)
+            rest_g, rest_specs, data_axes=nonzero_axes)
         layer_g = (
             allreduce_gradients_by_spec(
                 layer_g, layer_specs, data_axes=nonzero_axes)
             if layer_specs is not None
             else allreduce_gradients(layer_g, nonzero_axes))
-        new_p, new_state, metrics = mp_opt.apply_gradients(
-            opt_state, p, dict(rest_g, layers=layer_g),
-            found_inf_reducer=reducer)
-        return (new_p, new_state,
-                collectives.pmean(loss, grad_axes), metrics)
+        return rest_g, layer_g
 
-    zero_fn = jax.shard_map(
-        zero_step, mesh=mesh,
-        in_specs=(specs, state_specs, data_spec, data_spec),
-        out_specs=(specs, state_specs, PartitionSpec(), PartitionSpec()),
-        check_vma=False)
+    if getattr(mp_opt, "zero_level", 2) >= 3:
+        if zero3 is None or model is None or num_microbatches is None:
+            raise ValueError(
+                "zero_level=3 needs zero3=(mp_opt.zero3_init(...)), model= "
+                "and num_microbatches= — the builder rebuilds the pipelined "
+                "loss around the per-layer JIT weight gather")
+        from apex_tpu.optimizers.distributed import gather_chunked_tree
+        from apex_tpu.transformer.pipeline_parallel import pipelined_loss_fn
+
+        meta = zero3.meta
+        layer_meta = meta.subtree("layers")
+        rest_meta = meta.select(
+            [k for k in meta.shapes if k != "layers"])
+        if with_aux:
+            run_layers = lambda lp, h: model.run_layers(  # noqa: E731
+                lp, h, return_aux=True, chunk_meta=layer_meta)
+            aux_to_loss = model.aux_to_loss
+        else:
+            run_layers = lambda lp, h: model.run_layers(  # noqa: E731
+                lp, h, chunk_meta=layer_meta)
+            aux_to_loss = None
+        pipe_loss3 = pipelined_loss_fn(
+            embed=model.embed,
+            run_layers=run_layers,
+            head_loss=lambda p, h, t: model.head(p, h, t),
+            num_microbatches=num_microbatches,
+            virtual_pipeline_size=virtual_pipeline_size,
+            aux_to_loss=aux_to_loss)
+
+        def zero3_step(p, opt_state, toks, tgts):
+            rest_c = {k: v for k, v in p.items() if k != "layers"}
+
+            def scaled_loss(rest_c, layer_c):
+                # non-layer params (embedding, head LN) gather once per
+                # step — the unavoidable O(embedding) working set; the
+                # layer stack stays chunked and gathers inside the loop
+                rest = gather_chunked_tree(rest_c, rest_meta)
+                return pipe_loss3(rest, layer_c, toks, tgts) \
+                    * opt_state.scaler.loss_scale
+
+            loss, (rest_g, layer_g) = jax.value_and_grad(
+                scaled_loss, argnums=(0, 1))(rest_c, p["layers"])
+            # grads are CHUNK trees, already reduce-scattered over the
+            # zero axis by the gather transposes — only the other axes
+            # (context partials, pipe embedding ties) reduce here
+            rest_g, layer_g = reduce_nonzero(rest_g, layer_g)
+            new_p, new_state, metrics = mp_opt.apply_gradients(
+                opt_state, p, dict(rest_g, layers=layer_g),
+                found_inf_reducer=reducer)
+            return (new_p, new_state,
+                    collectives.pmean(loss, grad_axes), metrics)
+
+        zero_fn = jax.shard_map(
+            zero3_step, mesh=mesh,
+            in_specs=(zero3.param_specs, zero3.state_specs,
+                      data_spec, data_spec),
+            out_specs=(zero3.param_specs, zero3.state_specs,
+                       PartitionSpec(), PartitionSpec()),
+            check_vma=False)
+    else:
+
+        def zero_step(p, opt_state, toks, tgts):
+            rest = {k: v for k, v in p.items() if k != "layers"}
+
+            def scaled_loss(rest, layers):
+                return pipe_loss(rest, layers, toks, tgts) \
+                    * opt_state.scaler.loss_scale
+
+            loss, (rest_g, layer_g) = jax.value_and_grad(
+                scaled_loss, argnums=(0, 1))(rest, p["layers"])
+            rest_g, layer_g = reduce_nonzero(rest_g, layer_g)
+            new_p, new_state, metrics = mp_opt.apply_gradients(
+                opt_state, p, dict(rest_g, layers=layer_g),
+                found_inf_reducer=reducer)
+            return (new_p, new_state,
+                    collectives.pmean(loss, grad_axes), metrics)
+
+        zero_fn = jax.shard_map(
+            zero_step, mesh=mesh,
+            in_specs=(specs, state_specs, data_spec, data_spec),
+            out_specs=(specs, state_specs, PartitionSpec(), PartitionSpec()),
+            check_vma=False)
 
     @jax.jit
     def train_step(params, opt_state, tokens, targets):

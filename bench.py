@@ -176,6 +176,15 @@ def _stats(rates):
     }
 
 
+def _zero_env_level():
+    """(zero, zero_level) from BENCH_ZERO — ONE value mapping for the
+    program builder and the rung provenance ('3' -> level 3, any other
+    non-empty value -> level 2, unset -> off)."""
+    zero_env = os.environ.get("BENCH_ZERO", "")
+    zero = bool(zero_env)
+    return zero, (3 if zero_env.strip() == "3" else 2 if zero else 0)
+
+
 def _is_oom(e: Exception) -> bool:
     # walk the cause chain: the ladder re-raises OOMs as RuntimeError with
     # the jaxlib RESOURCE_EXHAUSTED as __cause__
@@ -244,11 +253,15 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
     fused = policy_level == "O2"
     # BENCH_ZERO=1 arms the ZeRO optimizer path (fp32 masters + moments
     # sharded over a data mesh, psum_scatter/bf16-gather inside the step).
-    # On this single-chip target the data axis has size 1 — the collectives
-    # are degenerate — but the rung exercises the exact end-to-end program
-    # a dp>1 pod runs, through the tunnel, with rung provenance recording
+    # BENCH_ZERO=3 arms the fully-sharded (ZeRO-3) drive on top: the bf16
+    # params persist as chunk trees and each layer's weights all-gather
+    # just-in-time inside the layer loop (run_layers chunk_meta). On this
+    # single-chip target the data axis has size 1 — the collectives are
+    # degenerate — but the rung exercises the exact end-to-end program a
+    # dp>1 pod runs, through the tunnel, with rung provenance recording
     # it. Off by default: the headline program stays byte-identical.
-    zero = bool(os.environ.get("BENCH_ZERO"))
+    zero, zero_level = _zero_env_level()
+    zero_level = zero_level or 2
     cfg = GPTConfig(
         vocab_size=50304,
         hidden_size=hidden or int(os.environ.get("BENCH_HIDDEN", "1024")),
@@ -278,6 +291,7 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
     mp_opt = amp.MixedPrecisionOptimizer(
         opt, policy, log_grad_norm=bool(os.environ.get("BENCH_JOURNAL")),
         zero_axis="data" if zero else None,
+        zero_level=zero_level,
         gather_dtype="bf16" if (zero and fused) else None)
     params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
 
@@ -287,15 +301,42 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
 
         mesh = Mesh(_np.array(jax.devices()[:1]), ("data",))
         pspecs = jax.tree.map(lambda _: _P(), params)
-        opt_state, zero_specs = mp_opt.zero_init(params, mesh, pspecs)
 
-        def zero_step(p, s, tokens, targets):
-            def scaled_loss(p):
-                return mp_opt.scale_loss(model.loss(p, tokens, targets), s)
+        if zero_level >= 3:
+            from apex_tpu.optimizers.distributed import gather_chunked_tree
 
-            loss_s, grads_s = jax.value_and_grad(scaled_loss)(p)
-            new_p, new_s, metrics = mp_opt.apply_gradients(s, p, grads_s)
-            return new_p, new_s, loss_s, metrics
+            z3 = mp_opt.zero3_init(params, mesh, pspecs)
+            layer_meta = z3.meta.subtree("layers")
+            rest_meta = z3.meta.select(
+                [k for k in z3.meta.shapes if k != "layers"])
+            params, opt_state = z3.params, z3.opt_state
+            pspecs, zero_specs = z3.param_specs, z3.state_specs
+
+            def zero_step(p, s, tokens, targets):
+                rest_c = {k: v for k, v in p.items() if k != "layers"}
+
+                def scaled_loss(rest_c, layer_c):
+                    rest = gather_chunked_tree(rest_c, rest_meta)
+                    return mp_opt.scale_loss(
+                        model.loss(dict(rest, layers=layer_c), tokens,
+                                   targets, layer_chunk_meta=layer_meta), s)
+
+                loss_s, (rg, lg) = jax.value_and_grad(
+                    scaled_loss, argnums=(0, 1))(rest_c, p["layers"])
+                new_p, new_s, metrics = mp_opt.apply_gradients(
+                    s, p, dict(rg, layers=lg))
+                return new_p, new_s, loss_s, metrics
+        else:
+            opt_state, zero_specs = mp_opt.zero_init(params, mesh, pspecs)
+
+            def zero_step(p, s, tokens, targets):
+                def scaled_loss(p):
+                    return mp_opt.scale_loss(
+                        model.loss(p, tokens, targets), s)
+
+                loss_s, grads_s = jax.value_and_grad(scaled_loss)(p)
+                new_p, new_s, metrics = mp_opt.apply_gradients(s, p, grads_s)
+                return new_p, new_s, loss_s, metrics
 
         step = jax.shard_map(
             zero_step, mesh=mesh,
@@ -435,11 +476,12 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
                     # one extra TRACE (no compile) arms per-window MFU
                     _register_window_costs(f"gpt_{level}", step,
                                            prep[4][0], prep[4][1], batch, seq)
+                zero, zero_level = _zero_env_level()
                 return prep + (batch, {"remat": remat_policy or "full",
                                        "scan": scan_chunk,
                                        "unroll": unroll,
-                                       "zero": bool(
-                                           os.environ.get("BENCH_ZERO"))})
+                                       "zero": zero,
+                                       "zero_level": zero_level})
             except Exception as e:  # noqa: BLE001 - jaxlib error types vary
                 if not _is_oom(e):
                     raise

@@ -46,19 +46,23 @@ from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 # Megatron-style sequence parallelism on the TP axis
 # (GPTConfig.sequence_parallel), "zero" = ZeRO-sharded optimizer over the
 # data axis (amp.MixedPrecisionOptimizer(zero_axis="data") with a bf16-
-# compressed param gather). Each marked config records its comm/static-
-# hazard blocks next to the plain twin so the decomposed-collective
-# structure shows up in scaling_table.json.
-GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (4, 2, 1), (4, 2, 1, 1, "sp"),
-        (2, 1, 4), (1, 2, 4), (2, 1, 2, 2)]
+# compressed param gather), "zero3" = fully-sharded params on top
+# (zero_level=3: the bf16 model persists as 1/dp chunk trees with
+# per-layer just-in-time weight gathers in the layer loop). Each marked
+# config records its comm/static-hazard blocks next to the plain twin so
+# the decomposed-collective structure shows up in scaling_table.json.
+GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (8, 1, 1, 1, "zero3"), (4, 2, 1),
+        (4, 2, 1, 1, "sp"), (2, 1, 4), (1, 2, 4), (2, 1, 2, 2)]
 
 
 def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
                micro_batch, n_micro, steps, sequence_parallel=False,
-               zero=False):
+               zero=False, zero_level=None):
     n_dev = dp * tp * pp * cp
     if len(jax.devices()) < n_dev:
         return None
+    zero_level = zero_level or (2 if zero else 0)
+    zero = zero_level > 0
     mesh = mesh_lib.make_virtual_mesh(
         n_dev, tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp,
         context_parallel_size=cp)
@@ -80,6 +84,7 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         mp_opt = amp.MixedPrecisionOptimizer(
             FusedAdam(lr=1e-4), policy,
             zero_axis=mesh_lib.AXIS_DATA if zero else None,
+            zero_level=zero_level or 2,
             gather_dtype="bf16" if zero else None)
         full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
         # shared TP x PP wiring (specs, placement, pipelined loss)
@@ -102,7 +107,23 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             lg = allreduce_gradients(lg, grad_axes)
             return collectives.pmean(loss, grad_axes), dict(rg, layers=lg)
 
-        if zero:
+        if zero_level >= 3:
+            # ZeRO-3: the bf16 params persist as 1/dp chunk trees; each
+            # layer's weights all-gather just-in-time inside the layer
+            # loop and grads reduce-scatter per layer via the gather
+            # transposes (no bulk post-update gather — tripwire:
+            # lint.trace.zero3_gather_hazards)
+            from apex_tpu.transformer.amp import build_zero_train_step
+
+            z3 = mp_opt.zero3_init(params, mesh, specs)
+            params, opt_state = z3.params, z3.opt_state
+            train_step = build_zero_train_step(
+                mp_opt, mesh, None, None, None,
+                rest_specs=rest_specs, layer_specs=specs["layers"],
+                grad_axes=grad_axes, data_spec=data_spec,
+                zero_axis=mesh_lib.AXIS_DATA,
+                zero3=z3, model=model, num_microbatches=n_micro)
+        elif zero:
             # ZeRO: the sharded optimizer's collectives live inside the
             # step's shard_map; the data axis drops from the harness
             # reduction (the scatter IS it) — the comm_accounting block
@@ -163,6 +184,7 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             conf["sequence_parallel"] = True
         if zero:
             conf["zero"] = True
+            conf["zero_level"] = zero_level
         row = {
             "config": conf,
             "avg_iteration_time_s": round(dt, 4),
@@ -209,6 +231,86 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         return row
     finally:
         mesh_lib.destroy_model_parallel()
+
+
+# per-chip HBM budget the placement rung prices against: 16 GiB, the
+# v5e-class part the tunnel chip reports. Placement — not bandwidth — is
+# the binding constraint on the co-tenant target (PERF_NOTES r5).
+PLACEMENT_HBM_BYTES = 16 * 1024**3
+
+
+def placement_rung(*, hidden=2560, layers=34, heads=32, vocab=50304,
+                   seq=2048, dp=8, hbm_bytes=PLACEMENT_HBM_BYTES):
+    """The large-model rung: a 2.7B-class GPT shape whose per-rank bytes
+    place under ZeRO-3 but NOT replicated.
+
+    This container cannot *execute* a step at this shape (a 2-core CPU
+    would take ~10 min/step), and placement is a bytes argument anyway —
+    so the rung prices persistent per-rank residency analytically
+    (``monitor.hbm.param_state_report``: working params + fp32 master +
+    moments, per ZeRO stage) against ``hbm_bytes``, and TRACES the
+    fully-sharded step at the full shape (``jax.make_jaxpr`` on abstract
+    ``ShapeDtypeStruct`` args: no allocation, no compile) to prove the
+    program gathers per layer with no model-sized bulk gather
+    (``lint.trace.zero3_gather_hazards`` census — the same tripwire the
+    selftest runs). Activations/grads ride on top of the priced floor;
+    replicated already fails on the floor alone.
+    """
+    from apex_tpu.lint import trace as lint_trace
+    from apex_tpu.monitor.hbm import param_state_report
+    from apex_tpu.optimizers.distributed import gather_chunked_tree
+
+    cfg = GPTConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
+        axis=None, compute_dtype=jnp.bfloat16, remat=True)
+    model = GPTModel(cfg)
+    policy = amp.get_policy("O2")
+    abstract = jax.eval_shape(
+        lambda k: amp.cast_params(model.init(k), policy),
+        jax.random.PRNGKey(0))
+    report = param_state_report(abstract, dp)
+    n_params = report["param_count"]
+
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-4), policy, zero_axis=mesh_lib.AXIS_DATA,
+        zero_level=3, gather_dtype="bf16")
+    meta = mp_opt.zero3_meta(abstract)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+
+    def zero3_loss(p, toks, tgts):
+        chunks = mp_opt.zero3_shard(p)
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        return model.loss(dict(rest, layers=chunks["layers"]), toks, tgts,
+                          layer_chunk_meta=layer_meta)
+
+    hz = lint_trace.zero3_gather_hazards(
+        jax.value_and_grad(zero3_loss), abstract, toks, toks,
+        axes={mesh_lib.AXIS_DATA: dp}, model_elems=n_params)
+
+    per_rank = report["per_rank"]
+    placed = {k: v["total_bytes"] < hbm_bytes for k, v in per_rank.items()}
+    return {
+        "config": {"dp": dp, "tp": 1, "pp": 1, "layers": layers,
+                   "hidden": hidden, "heads": heads, "seq": seq,
+                   "zero": True, "zero_level": 3, "placement_rung": True},
+        "param_count": int(n_params),
+        "param_state_report": report,
+        "hbm_budget_bytes": int(hbm_bytes),
+        "placed": placed,
+        "gather_census": {"hazard": hz["hazard"],
+                          "layer_gathers": hz["layer_gathers"],
+                          "bulk_gathers": hz["bulk_gathers"],
+                          "min_model_elems": hz["min_model_elems"]},
+        "basis": ("analytic+trace: bytes from monitor.hbm."
+                  "param_state_report (persistent state only), census "
+                  "from lint.trace.zero3_gather_hazards on the "
+                  "full-shape jaxpr; this container cannot execute a "
+                  "2.7B-class step"),
+    }
 
 
 def _overlap_evidence(compiled):
@@ -272,28 +374,38 @@ _TABLE_NOTES = {
         "the hybrid train step against a v5e:2x4 topology shows "
         "collective-permute-start/done pairs with compute scheduled "
         "between them (benchmarks/overlap_evidence.py)."),
+    "placement_rung": (
+        "the 2.7B-class row prices PERSISTENT per-rank residency "
+        "(monitor.hbm.param_state_report: working params + fp32 "
+        "master/moments, per ZeRO stage) against a 16 GiB HBM budget and "
+        "traces the fully-sharded step at the full shape for the "
+        "per-layer-gather census — analytic+trace evidence, not a timed "
+        "run (this container cannot execute that shape)."),
 }
 
 
 def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
-             steps, output_dir=None, grid=GRID):
+             steps, output_dir=None, grid=GRID, big_rung=False):
     """Sweep ``grid`` × ``layers_list`` (the reference ramps layer counts per
     config, gpt_scaling_test.py:53-57). One JSON artifact per (config,
     layers) when ``output_dir`` is set, plus a combined ``scaling_table``;
-    returns the result rows."""
+    returns the result rows. ``big_rung=True`` appends the 2.7B-class
+    :func:`placement_rung` row (analytic residency + full-shape gather
+    census) to the table."""
     rows = []
     for entry in grid:
         dp, tp, pp = entry[:3]
         cp = entry[3] if len(entry) > 3 else 1
         marks = set(entry[4:])
         sp = "sp" in marks
-        zero = "zero" in marks
+        zero_level = 3 if "zero3" in marks else 2 if "zero" in marks else 0
+        zero = zero_level > 0
         for layers in layers_list:
             res = run_config(
                 dp, tp, pp, cp, hidden=hidden, layers=layers, heads=heads,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
                 n_micro=n_micro, steps=steps, sequence_parallel=sp,
-                zero=zero)
+                zero_level=zero_level)
             if res is None:
                 # not enough devices — no layer count will change that;
                 # record ONE skipped row for this config and move on
@@ -305,6 +417,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                     res["config"]["sequence_parallel"] = True
                 if zero:
                     res["config"]["zero"] = True
+                    res["config"]["zero_level"] = zero_level
                 rows.append(res)
                 print(json.dumps(res), flush=True)
                 break
@@ -314,10 +427,11 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             # stored cp>1 (or sequence-parallel/zero) row down to a smaller
             # key set would make a later plain config look like its
             # duplicate and silently skip it
-            defaults = {"cp": 1, "sequence_parallel": False, "zero": False}
+            defaults = {"cp": 1, "sequence_parallel": False, "zero": False,
+                        "zero_level": 0}
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
                         "sequence_parallel": sp and tp > 1, "zero": zero,
-                        "layers": eff}
+                        "zero_level": zero_level, "layers": eff}
             if any({k: r["config"].get(k, defaults.get(k, 1))
                     for k in base_cfg} == base_cfg
                    for r in rows):
@@ -336,10 +450,22 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                 os.makedirs(output_dir, exist_ok=True)
                 cp_tag = f"_cp{cp}" if cp > 1 else ""
                 cp_tag += "_sp" if sp and tp > 1 else ""
-                cp_tag += "_zero" if zero else ""
+                cp_tag += ("_zero3" if zero_level >= 3
+                           else "_zero" if zero else "")
                 name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
                 with open(os.path.join(output_dir, name), "w") as f:
                     json.dump(res, f, indent=1)
+    if big_rung:
+        res = placement_rung()
+        rows.append(res)
+        print(json.dumps(res), flush=True)
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
+            c = res["config"]
+            name = (f"scaling_placement_dp{c['dp']}_h{c['hidden']}"
+                    f"_l{c['layers']}_zero3.json")
+            with open(os.path.join(output_dir, name), "w") as f:
+                json.dump(res, f, indent=1)
     if output_dir:
         with open(os.path.join(output_dir, "scaling_table.json"), "w") as f:
             json.dump({"notes": _TABLE_NOTES, "rows": rows}, f, indent=1)
@@ -351,8 +477,15 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
     for r in rows:
         c = r["config"]
         sp_mark = ("sp" if c.get("sequence_parallel")
+                   else "zero3" if c.get("zero_level", 0) >= 3
                    else "zero" if c.get("zero") else "-")
-        if "skipped" in r:
+        if c.get("placement_rung"):
+            z3 = r["param_state_report"]["per_rank"]["zero3"]["total_bytes"]
+            print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
+                  f"{c.get('cp', 1):>3} {sp_mark:>5} {c['layers']:>6} "
+                  f"{'placed' if r['placed']['zero3'] else 'OVER':>9} "
+                  f"{z3 / 2**30:>8.2f}G")
+        elif "skipped" in r:
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
                   f"{c.get('cp', 1):>3} {sp_mark:>5} "
                   f"{c.get('layers', '-'):>6} {'skipped':>9}")
@@ -383,13 +516,17 @@ def main():
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--output-dir", type=str, default=None,
                    help="write one JSON artifact per config plus scaling_table.json")
+    p.add_argument("--no-big-rung", action="store_true",
+                   help="skip the 2.7B-class placement rung (analytic "
+                        "residency + full-shape gather census)")
     args = p.parse_args()
     run_grid(
         hidden=args.hidden,
         layers_list=[int(x) for x in args.layers.split(",")],
         heads=args.heads, vocab=args.vocab, seq=args.seq,
         micro_batch=args.micro_batch, n_micro=args.num_microbatches,
-        steps=args.steps, output_dir=args.output_dir)
+        steps=args.steps, output_dir=args.output_dir,
+        big_rung=not args.no_big_rung)
 
 
 if __name__ == "__main__":

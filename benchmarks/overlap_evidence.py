@@ -46,6 +46,21 @@ block (``monitor.hbm.optimizer_state_report`` at the 345M flagship
 shape, via ``eval_shape`` — no buffers) carries the bytes/rank ÷ dp
 claim. Default output: ``out/zero_evidence.json``.
 
+ZeRO-3 (r9): ``--zero3`` is the fully-sharded-param evidence mode
+(host-side trace only, no TPU): the SAME dp-only loss+grad is traced
+through the fully-sharded drive (``zero3_shard`` chunks + per-layer
+just-in-time gathers via ``run_layers`` ``chunk_meta``) and through a
+bulk whole-stack-gather control, and the record shows per-layer gathers
+replacing the model-sized bulk gather — census from
+``lint.trace.zero3_gather_hazards`` (the bulk control IS the hazard;
+the ZeRO-3 step must trace clean) plus the conservation law from
+``monitor.comms.CommAccount`` (L per-layer gathers move exactly the
+bulk gather's bytes). A ``param_state_report`` block prices the 345M
+flagship's per-rank param+master+moment bytes per ZeRO stage, and a
+``placement_rung`` block (``benchmarks.gpt_scaling.placement_rung``)
+carries the 2.7B-class shape whose per-rank bytes place under ZeRO-3
+but not replicated. Default output: ``out/zero3_evidence.json``.
+
 Run (needs the axon PJRT plugin for the TPU compile client; no chip
 time is used — this is compile-only):
     PYTHONPATH=/root/repo:/root/.axon_site python \
@@ -317,6 +332,213 @@ def zero_evidence_census(dp, *, hidden, layers, heads, seq, vocab):
     return out
 
 
+def zero3_gather_census(dp, *, hidden, layers, heads, seq, vocab):
+    """The ZeRO-3 per-layer-gather claim as numbers — host-side trace only.
+
+    Traces the SAME dp-only O2 loss+grad two ways under an axis_env
+    binding: the fully-sharded drive (``zero3_shard`` chunks; each layer's
+    weights all-gather just-in-time inside the unrolled layer loop via
+    ``run_layers`` ``chunk_meta``) and a bulk control that gathers every
+    stacked layer leaf whole before the loss (the O(model)
+    rematerialization ZeRO-3 removes). Reports, per mode: the
+    ``lint.trace.zero3_gather_hazards`` census (the control must flag, the
+    ZeRO-3 step must trace clean with >= num_layers layer gathers) and the
+    data-axis ``all_gather`` payload bytes from ``monitor.comms.
+    CommAccount`` — the conservation law: L per-layer gathers move exactly
+    the bytes of the one whole-stack gather they replace (every leaf row
+    here divides by dp, so no padding slack)."""
+    from apex_tpu import amp
+    from apex_tpu.lint.trace import zero3_gather_hazards
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.monitor.comms import comm_accounting
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.distributed import (
+        gather_chunked_tree,
+        gather_stacked_leaf,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
+        axis=None, compute_dtype=jnp.bfloat16, unroll_layers=True)
+    model = GPTModel(cfg)
+    policy = amp.get_policy("O2")
+    # zero-valued params at full shape: values are unused for COUNTING
+    params = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype),
+        jax.eval_shape(lambda k: amp.cast_params(model.init(k), policy),
+                       jax.random.PRNGKey(0)))
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-4), policy, zero_axis="data", zero_level=3,
+        gather_dtype="bf16")
+    meta = mp_opt.zero3_meta(params)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jnp.zeros((2, seq), jnp.int32)
+
+    def jit_gather_loss(p):
+        chunks = mp_opt.zero3_shard(p)
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        return model.loss(dict(rest, layers=chunks["layers"]), toks, toks,
+                          layer_chunk_meta=layer_meta)
+
+    def bulk_gather_loss(p):
+        chunks = mp_opt.zero3_shard(p)
+        layers_full = jax.tree.map(
+            lambda c, s: gather_stacked_leaf(c, s.shape, s.dtype, "data",
+                                             gather_dtype=jnp.bfloat16),
+            chunks["layers"], layer_meta.shapes,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        return model.loss(dict(rest, layers=layers_full), toks, toks)
+
+    out = {}
+    for label, fn in (("zero3_per_layer", jit_gather_loss),
+                      ("bulk_control", bulk_gather_loss)):
+        with comm_accounting() as acct:
+            jx = jax.make_jaxpr(jax.value_and_grad(fn),
+                                axis_env=[("data", dp)])(params)
+        hz = zero3_gather_hazards(jx, zero_axis="data",
+                                  model_elems=n_params)
+        gathers = [r for r in acct.records
+                   if r["axis"] == "data" and r["verb"] == "all_gather"]
+        out[label] = {
+            "hazard": hz["hazard"],
+            "layer_gathers": hz["layer_gathers"],
+            "bulk_gathers": hz["bulk_gathers"],
+            "min_model_elems": hz["min_model_elems"],
+            "gather_bytes": sum(r["bytes"] for r in gathers),
+            "gather_calls": len(gathers),
+        }
+
+    # conservation components, each traced alone: ONE layer's JIT gather
+    # and the once-per-step rest gather. (In the full step trace above the
+    # remat trace cache books the identically-shaped layer body once, so
+    # its tally is rest + 1 layer — the components let the record state
+    # rest + L x layer == bulk exactly.)
+    from apex_tpu.optimizers.distributed import chunk_size
+
+    def chunk_of(s):
+        size = 1
+        for d in s.shape:
+            size *= int(d)
+        return jnp.zeros((chunk_size(size, dp),), s.dtype)
+
+    is_sds = lambda x: isinstance(x, jax.ShapeDtypeStruct)  # noqa: E731
+    layer0 = jax.tree.map(chunk_of, layer_meta.shapes, is_leaf=is_sds)
+    rest0 = jax.tree.map(chunk_of, rest_meta.shapes, is_leaf=is_sds)
+    with comm_accounting() as acct_layer:
+        jax.make_jaxpr(lambda c: gather_chunked_tree(c, layer_meta),
+                       axis_env=[("data", dp)])(layer0)
+    with comm_accounting() as acct_rest:
+        jax.make_jaxpr(lambda c: gather_chunked_tree(c, rest_meta),
+                       axis_env=[("data", dp)])(rest0)
+    out["components"] = {
+        "one_layer_gather_bytes": sum(
+            r["bytes"] for r in acct_layer.records
+            if r["axis"] == "data" and r["verb"] == "all_gather"),
+        "rest_gather_bytes": sum(
+            r["bytes"] for r in acct_rest.records
+            if r["axis"] == "data" and r["verb"] == "all_gather"),
+        "num_layers": int(layers),
+    }
+    return out, n_params
+
+
+def _zero3_main(args) -> int:
+    """``--zero3``: the fully-sharded-param evidence record
+    (out/zero3_evidence.json)."""
+    record = {"metric": "zero3_fully_sharded_evidence", "dp": args.dp,
+              "hidden": args.hidden, "layers": args.layers,
+              "seq": args.seq, "vocab": args.vocab}
+    ok_census = ok_bytes = ok_report = ok_rung = False
+    try:
+        census, n_params = zero3_gather_census(
+            args.dp, hidden=args.hidden, layers=args.layers,
+            heads=args.heads, seq=args.seq, vocab=args.vocab)
+        record["gather_census"] = census
+        record["model_elems"] = int(n_params)
+        z3, bulk = census["zero3_per_layer"], census["bulk_control"]
+        ok_census = (not z3["hazard"]                   # per-layer only...
+                     and z3["bulk_gathers"] == 0
+                     and z3["layer_gathers"] >= args.layers
+                     and bulk["hazard"])                # ...and the control flags
+        # conservation law: rest + L x one-layer == the bulk gather's
+        # bytes exactly (every leaf row divides by dp here, no padding;
+        # the full-step tally is rest + ONE layer because the remat trace
+        # cache books the identically-shaped layer body once)
+        comp = census["components"]
+        per_layer_total = (comp["rest_gather_bytes"]
+                           + comp["num_layers"] * comp["one_layer_gather_bytes"])
+        ok_bytes = (per_layer_total == bulk["gather_bytes"]
+                    and per_layer_total > 0
+                    and z3["gather_bytes"] == (comp["rest_gather_bytes"]
+                                               + comp["one_layer_gather_bytes"]))
+        record["gather_byte_conservation"] = {
+            "rest_bytes": comp["rest_gather_bytes"],
+            "one_layer_bytes": comp["one_layer_gather_bytes"],
+            "num_layers": comp["num_layers"],
+            "per_layer_total_bytes": per_layer_total,
+            "bulk_bytes": bulk["gather_bytes"],
+            "step_trace_bytes": z3["gather_bytes"],
+            "step_trace_note": ("the remat trace cache books the "
+                                "identically-shaped layer body once: the "
+                                "step tally is rest + 1 layer"),
+            "equal": bool(per_layer_total == bulk["gather_bytes"]),
+        }
+    except Exception as e:  # noqa: BLE001 - a negative result is a result
+        record["census_error"] = str(e)[:400]
+    try:
+        # the 345M flagship shape, cast to O2 so the working copy prices
+        # bf16 (bench.py: hidden 1024 x 24 layers, vocab 50304) — the
+        # >=4x per-rank param-bytes claim at dp=8
+        from apex_tpu import amp
+        from apex_tpu.models import GPTConfig, GPTModel
+        from apex_tpu.monitor.hbm import param_state_report
+
+        flagship = GPTModel(GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_attention_heads=16, max_seq_len=1024, hidden_dropout=0.0,
+            axis=None, compute_dtype=jnp.bfloat16))
+        policy = amp.get_policy("O2")
+        abstract = jax.eval_shape(
+            lambda k: amp.cast_params(flagship.init(k), policy),
+            jax.random.PRNGKey(0))
+        report = param_state_report(abstract, args.dp)
+        record["param_state"] = dict(
+            report, shape="345M flagship (bench.py: hidden 1024 x 24 "
+                          "layers, vocab 50304; O2 bf16 working params)")
+        ok_report = report["param_ratio"] >= 4.0
+    except Exception as e:  # noqa: BLE001
+        record["param_state"] = {"error": str(e)[:200]}
+    try:
+        # the 2.7B-class placement rung (gpt_scaling.placement_rung):
+        # per-rank persistent bytes place under ZeRO-3, NOT replicated
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from gpt_scaling import placement_rung
+
+        rung = placement_rung(dp=args.dp)
+        record["placement_rung"] = rung
+        ok_rung = (bool(rung["placed"]["zero3"])
+                   and not rung["placed"]["replicated"]
+                   and not rung["gather_census"]["hazard"])
+    except Exception as e:  # noqa: BLE001
+        record["placement_rung"] = {"error": str(e)[:300]}
+    record["checks"] = {"census": ok_census, "byte_conservation": ok_bytes,
+                        "param_state_ratio": ok_report,
+                        "placement_rung": ok_rung}
+    record["ok"] = bool(ok_census and ok_bytes and ok_report and ok_rung)
+    print(json.dumps(record))
+    output = args.output or os.path.join("out", "zero3_evidence.json")
+    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+    with open(output, "w") as f:
+        json.dump(record, f, indent=1)
+    return 0 if record["ok"] else 1
+
+
 def _zero_main(args) -> int:
     """``--zero``: write the ZeRO evidence record (out/zero_evidence.json)."""
     record = {"metric": "zero_optimizer_evidence", "dp": args.dp,
@@ -394,11 +616,19 @@ def main():
                          "replicated vs sharded-optimizer collective "
                          "census + bytes per verb + the optimizer-state "
                          "bytes/rank table; writes out/zero_evidence.json")
+    ap.add_argument("--zero3", action="store_true",
+                    help="ZeRO-3 evidence mode (host-side, no TPU): "
+                         "per-layer JIT gather census vs the bulk-gather "
+                         "control, gather-byte conservation, the 345M "
+                         "param_state_report table, and the 2.7B-class "
+                         "placement rung; writes out/zero3_evidence.json")
     ap.add_argument("--dp", type=int, default=8,
                     help="data-axis size for the --zero census/state table")
     ap.add_argument("--output", default=None)
     args = ap.parse_args()
 
+    if args.zero3:
+        sys.exit(_zero3_main(args))
     if args.zero:
         sys.exit(_zero_main(args))
 

@@ -44,7 +44,16 @@ def parse_args():
                         "masters + LAMB moments sharded 1/dp "
                         "(LAMB trust-ratio norms psum across the shards); "
                         "batch must divide the device count")
-    return p.parse_args()
+    p.add_argument("--zero-level", type=int, default=None, choices=(1, 2, 3),
+                   help="ZeRO stage (implies --zero). 3 shards the bf16 "
+                        "params too: 1/dp chunk trees with per-layer "
+                        "just-in-time weight gathers in the layer loop")
+    args = p.parse_args()
+    if args.zero_level is not None:
+        args.zero = True
+    elif args.zero:
+        args.zero_level = 2
+    return args
 
 
 def synthetic_batch(rng, batch, seq, vocab):
@@ -88,6 +97,7 @@ def main():
             FusedLAMB(lr=args.lr, weight_decay=0.01,
                       norm_psum_axis="data"),
             policy, zero_axis="data",
+            zero_level=args.zero_level,
             # bf16 gather is free only when the model params already live
             # in half precision (cast O2/O3); for fp32-param policies
             # (O0/O1) it would round the weights every step.
@@ -95,17 +105,49 @@ def main():
             else None)
         params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
         pspecs = jax.tree.map(lambda _: P(), params)
-        state, zero_specs = mp_opt.zero_init(params, mesh, pspecs)
         data_spec = P("data")
 
-        def zero_step(p, s, toks, attn, lmask, labels, nsp, types):
-            def scaled(p):
-                return mp_opt.scale_loss(
-                    model.loss(p, toks, attn, lmask, labels, nsp, types), s)
+        if args.zero_level >= 3:
+            # fully-sharded: the bf16 params persist as 1/dp chunk trees;
+            # each layer's weights gather just-in-time inside the layer
+            # loop (run_layers chunk_meta) and grads arrive per-layer
+            # reduce-scattered via the gather transposes
+            from apex_tpu.optimizers.distributed import gather_chunked_tree
 
-            ls, gs = jax.value_and_grad(scaled)(p)
-            np_, ns, m = mp_opt.apply_gradients(s, p, gs)
-            return np_, ns, collectives.pmean(ls, "data"), m
+            z3 = mp_opt.zero3_init(params, mesh, pspecs)
+            layer_meta = z3.meta.subtree("layers")
+            rest_meta = z3.meta.select(
+                [k for k in z3.meta.shapes if k != "layers"])
+            params, state = z3.params, z3.opt_state
+            pspecs, zero_specs = z3.param_specs, z3.state_specs
+
+            def zero_step(p, s, toks, attn, lmask, labels, nsp, types):
+                rest_c = {k: v for k, v in p.items() if k != "layers"}
+
+                def scaled(rest_c, layer_c):
+                    rest = gather_chunked_tree(rest_c, rest_meta)
+                    return mp_opt.scale_loss(
+                        model.loss(dict(rest, layers=layer_c), toks, attn,
+                                   lmask, labels, nsp, types,
+                                   layer_chunk_meta=layer_meta), s)
+
+                ls, (rg, lg) = jax.value_and_grad(scaled, argnums=(0, 1))(
+                    rest_c, p["layers"])
+                np_, ns, m = mp_opt.apply_gradients(
+                    s, p, dict(rg, layers=lg))
+                return np_, ns, collectives.pmean(ls, "data"), m
+        else:
+            state, zero_specs = mp_opt.zero_init(params, mesh, pspecs)
+
+            def zero_step(p, s, toks, attn, lmask, labels, nsp, types):
+                def scaled(p):
+                    return mp_opt.scale_loss(
+                        model.loss(p, toks, attn, lmask, labels, nsp,
+                                   types), s)
+
+                ls, gs = jax.value_and_grad(scaled)(p)
+                np_, ns, m = mp_opt.apply_gradients(s, p, gs)
+                return np_, ns, collectives.pmean(ls, "data"), m
 
         zero_fn = jax.shard_map(
             zero_step, mesh=mesh,

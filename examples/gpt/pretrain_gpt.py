@@ -71,6 +71,13 @@ def parse_args():
                    help="ZeRO: shard fp32 masters + Adam moments over the "
                         "data axis (optimizer memory / dp; the grad "
                         "all-reduce becomes psum_scatter + all_gather)")
+    p.add_argument("--zero-level", type=int, default=None, choices=(1, 2, 3),
+                   help="ZeRO stage (implies --zero). 1/2: masters+moments "
+                        "shard 1/dp, bf16 params replicated. 3: the bf16 "
+                        "params shard too — each layer's weights are "
+                        "all-gathered just-in-time inside the layer loop "
+                        "and grads reduce-scatter per layer (no bulk "
+                        "post-update gather)")
     p.add_argument("--zero-gather", default=None, choices=["bf16"],
                    help="compress the ZeRO param all-gather payload "
                         "(halves gather bytes; fp32 masters stay exact)")
@@ -83,6 +90,10 @@ def parse_args():
                         "grad-norm, loss-scale state, HBM samples); adds "
                         "one loss fetch per step")
     args = p.parse_args()
+    if args.zero_level is not None:
+        args.zero = True
+    elif args.zero:
+        args.zero_level = 2
     if args.zero_gather and not args.zero:
         p.error("--zero-gather requires --zero")
     return args
@@ -122,6 +133,7 @@ def main():
         log_grad_norm=bool(args.journal),
         log_group_norms=bool(args.journal),
         zero_axis=mesh_lib.AXIS_DATA if args.zero else None,
+        zero_level=args.zero_level or 2,
         gather_dtype=args.zero_gather)
 
     full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
@@ -164,11 +176,29 @@ def main():
         # model/pipe axes like the reference's model-parallel GradScaler.
         from apex_tpu.transformer.amp import build_zero_train_step
 
-        opt_state, state_specs = mp_opt.zero_init(params, mesh, specs)
-        train_step = build_zero_train_step(
-            mp_opt, mesh, specs, state_specs, pipe_loss,
-            rest_specs=rest_specs, grad_axes=grad_axes,
-            data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA)
+        if args.zero_level >= 3:
+            # ZeRO-3: the bf16 params persist as 1/dp chunk trees and
+            # each layer's weights gather just-in-time inside the layer
+            # loop (models/_transformer.run_layers chunk_meta); grads
+            # reduce-scatter per layer via the gather transposes, and
+            # the updated chunks ARE the state — no post-update gather
+            # (tripwire: lint.trace.zero3_gather_hazards)
+            z3 = mp_opt.zero3_init(params, mesh, specs)
+            params = z3.params
+            opt_state = z3.opt_state
+            train_step = build_zero_train_step(
+                mp_opt, mesh, None, None, None,
+                rest_specs=rest_specs, layer_specs=specs["layers"],
+                grad_axes=grad_axes,
+                data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA,
+                zero3=z3, model=model,
+                num_microbatches=args.num_microbatches)
+        else:
+            opt_state, state_specs = mp_opt.zero_init(params, mesh, specs)
+            train_step = build_zero_train_step(
+                mp_opt, mesh, specs, state_specs, pipe_loss,
+                rest_specs=rest_specs, grad_axes=grad_axes,
+                data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA)
     else:
         opt_state = mp_opt.init(params)
         shard_fn = jax.shard_map(
@@ -224,16 +254,19 @@ def main():
             args.journal, sample_hbm_every=10,
             meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
                   "dp": dp, "hidden": args.hidden, "layers": args.layers,
-                  "seq": args.seq, "batch": batch, "zero": bool(args.zero)})
+                  "seq": args.seq, "batch": batch, "zero": bool(args.zero),
+                  "zero_level": args.zero_level or 0})
         try:
-            # per-rank optimizer-state footprint (monitor/hbm.py): the
-            # ZeRO bytes/rank ÷ dp claim as a journaled number, rolled up
-            # by `python -m apex_tpu.monitor.report`
-            from apex_tpu.monitor.hbm import opt_state_bytes
+            # per-rank residency footprints (monitor/hbm.py): the ZeRO
+            # bytes/rank ÷ dp claim — and under --zero-level 3 the
+            # param bytes/rank ÷ dp claim — as journaled numbers, rolled
+            # up by `python -m apex_tpu.monitor.report`
+            from apex_tpu.monitor.hbm import opt_state_bytes, param_bytes
 
             journal.set_opt_state_bytes(opt_state_bytes(opt_state))
+            journal.set_param_bytes(param_bytes(params))
         except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
-            print(f"opt-state-bytes arming failed: {e}")
+            print(f"residency-bytes arming failed: {e}")
         # diagnostics engine (monitor/diagnose.py): overflow/loss-spike
         # forensics keyed off the per-group grad norms above, plus the
         # shape-churn detector around the jitted step — both host-side

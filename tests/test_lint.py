@@ -589,6 +589,106 @@ def test_zero_redundancy_on_real_mixed_precision_step():
 
 
 # ---------------------------------------------------------------------------
+# engine 2: ZeRO-3 bulk-gather tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_zero3_gather_flags_whole_stack_gather():
+    """A whole-stack (model-sized) param gather in a fully-sharded step is
+    the O(model) rematerialization; the result-sized rule catches it even
+    though the OPERAND is the small per-rank chunk stack."""
+    from apex_tpu.optimizers.distributed import gather_stacked_leaf
+
+    chunks = jnp.ones((8, 64), jnp.float32)  # (L, k) at n=8
+
+    hz = trace.zero3_gather_hazards(
+        lambda c: gather_stacked_leaf(c, (8, 64), jnp.float32, "data"),
+        chunks, axes={"data": 8}, model_elems=8 * 512)
+    assert hz["hazard"] and hz["bulk_gathers"] == 1, hz
+    assert hz["findings"][0]["rule"] == "zero3-bulk-gather"
+    assert hz["census"]["bulk_sites"][0]["result_elems"] == 8 * 512
+    assert "per-layer" in hz["findings"][0]["message"]
+
+
+def test_zero3_gather_passes_per_layer_gathers():
+    from apex_tpu.optimizers.distributed import gather_leaf
+
+    L, row = 8, (8, 64)
+    chunks = jnp.ones((L, 64), jnp.float32)
+
+    def per_layer(c):
+        return jnp.stack([gather_leaf(c[i], row, jnp.float32, "data",
+                                      gather_dtype=jnp.bfloat16)
+                          for i in range(L)])
+
+    hz = trace.zero3_gather_hazards(per_layer, chunks, axes={"data": 8},
+                                    model_elems=L * 512)
+    assert not hz["hazard"], hz
+    assert hz["layer_gathers"] == L and hz["bulk_gathers"] == 0
+    # threshold derivation: bulk_fraction (0.25 default) of the model
+    assert hz["min_model_elems"] == L * 512 // 4
+
+
+def test_zero3_gather_on_real_gpt_step():
+    """The real fully-sharded drive (zero3_shard + run_layers chunk_meta)
+    traces clean through value_and_grad — every gather, forward AND the
+    remat re-gathers in backward, is one layer's params — while
+    materializing the stacked leaves whole before the loss is flagged."""
+    import jax
+
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.distributed import (
+        gather_chunked_tree,
+        gather_stacked_leaf,
+    )
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=8,
+                    num_attention_heads=2, max_seq_len=8,
+                    hidden_dropout=0.0, axis=None, unroll_layers=True)
+    model = GPTModel(cfg)
+    params = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype),
+        jax.eval_shape(model.init, jax.random.PRNGKey(0)))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-3), amp.get_policy("O2"),
+        zero_axis="data", zero_level=3)
+    meta = mp_opt.zero3_meta(params)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jnp.zeros((2, 8), jnp.int32)
+    # any single-layer row gather is <= ~1k elems; a stacked-leaf gather
+    # is L x that — 4096 splits them
+    thresh = dict(axes={"data": 8}, min_model_elems=4096)
+
+    def jit_gather_loss(p):
+        chunks = mp_opt.zero3_shard(p)
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        return model.loss(dict(rest, layers=chunks["layers"]), toks, toks,
+                          layer_chunk_meta=layer_meta)
+
+    hz = trace.zero3_gather_hazards(
+        jax.value_and_grad(jit_gather_loss), params, **thresh)
+    assert not hz["hazard"], hz
+    assert hz["layer_gathers"] >= cfg.num_layers  # unrolled: per layer
+
+    def bulk_gather_loss(p):
+        chunks = mp_opt.zero3_shard(p)
+        layers = jax.tree.map(
+            lambda c, s: gather_stacked_leaf(c, s.shape, s.dtype, "data"),
+            chunks["layers"], layer_meta.shapes,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        return model.loss(dict(rest, layers=layers), toks, toks)
+
+    hz = trace.zero3_gather_hazards(bulk_gather_loss, params, **thresh)
+    assert hz["hazard"] and hz["bulk_gathers"] >= 1, hz
+
+
+# ---------------------------------------------------------------------------
 # engine 2: recompile-hazard scanner
 # ---------------------------------------------------------------------------
 

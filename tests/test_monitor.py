@@ -297,6 +297,47 @@ def test_comm_accounting_tallies_sequence_parallel_psum_scatter():
     assert acct.by_verb()["psum_scatter"]["bytes"] == nbytes * 4  # gathered
 
 
+def test_comm_per_layer_gather_bytes_match_bulk_gather():
+    """The ZeRO-3 conservation law: L per-layer JIT gathers move exactly
+    the bytes of the one whole-stack gather they replace (chunk layouts
+    agree row for row when the row size divides the axis), and both book
+    at the CAST wire dtype — the compressed-gather claim stays a reported
+    number on the per-layer path too."""
+    from apex_tpu.optimizers.distributed import (
+        gather_leaf,
+        gather_stacked_leaf,
+    )
+
+    L, row, n = 4, (16, 32), 8  # 512 elems/row, divisible by n: no padding
+    k = 16 * 32 // n
+    chunks = jnp.ones((L, k), jnp.float32)
+
+    def per_layer(c):
+        return jnp.stack([gather_leaf(c[i], row, jnp.float32, "data",
+                                      gather_dtype=jnp.bfloat16)
+                          for i in range(L)])
+
+    def bulk(c):
+        return gather_stacked_leaf(c, row, jnp.float32, "data",
+                                   gather_dtype=jnp.bfloat16)
+
+    with comm_accounting() as acct_layer:
+        jax.make_jaxpr(per_layer, axis_env=[("data", n)])(chunks)
+    with comm_accounting() as acct_bulk:
+        jax.make_jaxpr(bulk, axis_env=[("data", n)])(chunks)
+    a, b = acct_layer.by_axis()["data"], acct_bulk.by_axis()["data"]
+    assert a["bytes"] == b["bytes"] == L * k * 2  # bf16 wire: 2 B/elem
+    assert a["calls"] == L and b["calls"] == 1
+
+    # without gather_dtype the wire payload doubles — the tally sees it
+    with comm_accounting() as acct_fp32:
+        jax.make_jaxpr(
+            lambda c: jnp.stack([gather_leaf(c[i], row, jnp.float32, "data")
+                                 for i in range(L)]),
+            axis_env=[("data", n)])(chunks)
+    assert acct_fp32.by_axis()["data"]["bytes"] == L * k * 4
+
+
 def test_sequence_parallel_activation_report():
     """The tp-x memory claim as a number: per-layer sequence-region bytes
     shrink by exactly tp (both sides use the same lane-padded shape
@@ -357,6 +398,38 @@ def test_optimizer_state_report_flagship_ratio():
         rep["replicated_padded_bytes_per_rank"] / 7
 
 
+def test_param_state_report_flagship_zero3_ratio():
+    """param_state_report: the WORKING params (bf16 under O2) divide by dp
+    under ZeRO-3 while ZeRO-1/2 keeps them replicated — the >=4x per-rank
+    param-bytes reduction at dp=8 the ZeRO-3 evidence bar requires, on
+    the 345M flagship shape via eval_shape alone."""
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.monitor.hbm import param_state_report
+
+    model = GPTModel(GPTConfig(
+        vocab_size=50304, hidden_size=1024, num_layers=24,
+        num_attention_heads=16, max_seq_len=1024, hidden_dropout=0.0,
+        axis=None, compute_dtype=jnp.bfloat16))
+    abstract = jax.eval_shape(
+        lambda k: amp.cast_params(model.init(k), amp.get_policy("O2")),
+        jax.random.PRNGKey(0))
+    rep = param_state_report(abstract, dp=8)
+    assert rep["param_count"] > 340e6
+    t = rep["per_rank"]
+    # bf16 working copy: ~2 bytes/param replicated, ~/dp under ZeRO-3
+    assert t["replicated"]["param_bytes"] > 0.6e9
+    assert t["zero12"]["param_bytes"] == t["replicated"]["param_bytes"]
+    assert rep["param_ratio"] >= 4.0  # the evidence-bar floor (dp=8: ~8x)
+    assert t["zero3"]["param_bytes"] < t["replicated"]["param_bytes"] / 4
+    # fp32 master+moment chunks shared by zero12 and zero3
+    assert t["zero12"]["opt_bytes"] == t["zero3"]["opt_bytes"]
+    assert t["replicated"]["opt_bytes"] > 4e9
+    # the residency ordering the three modes exist to produce
+    assert t["zero3"]["total_bytes"] < t["zero12"]["total_bytes"] \
+        < t["replicated"]["total_bytes"]
+
+
 def test_opt_state_bytes_reports_per_rank_shards():
     """opt_state_bytes: a ZeRO-sharded leaf books its per-device chunk,
     a replicated leaf books the full array — so the same call reports the
@@ -391,6 +464,35 @@ def test_journal_carries_opt_state_bytes(tmp_path):
     rows = [r for r in MetricsJournal.read(path) if r["kind"] == "step"]
     assert "opt_state_bytes" not in rows[0]
     assert rows[1]["opt_state_bytes"] == 123456
+
+
+def test_journal_carries_param_bytes_and_report_rolls_up(tmp_path):
+    """set_param_bytes stamps per-step param residency; report.analyze
+    rolls it up and compare flags a run whose footprint GREW (the
+    silently-dropped-ZeRO-3 regression no throughput check would see)."""
+    from apex_tpu.monitor import report
+
+    def write(path, nbytes):
+        with MetricsJournal(path) as j:
+            j.set_param_bytes(nbytes)
+            j.set_opt_state_bytes(nbytes * 6)
+            for step in range(4):
+                j.log({"kind": "step", "step": step, "wall_s": 0.1,
+                       "loss": 2.0, "tokens": 64, "tokens_per_sec": 640.0,
+                       "overflows": 0, "param_bytes": nbytes,
+                       "opt_state_bytes": nbytes * 6})
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    write(a, 100_000_000)   # ZeRO-3 run
+    write(b, 800_000_000)   # params re-replicated: 8x the footprint
+    ra = report.analyze(MetricsJournal.read(a))
+    assert ra["param_bytes"] == {"last": 100_000_000, "peak": 100_000_000}
+    cmp = report.compare(MetricsJournal.read(a), MetricsJournal.read(b))
+    assert "param_bytes_last" in cmp["regressed"], cmp
+    assert "opt_state_bytes_last" in cmp["regressed"], cmp
+    # same-footprint candidate passes
+    assert report.compare(MetricsJournal.read(a),
+                          MetricsJournal.read(a))["ok"]
 
 
 def test_comm_account_reentrancy():

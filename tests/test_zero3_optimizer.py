@@ -1,0 +1,391 @@
+"""ZeRO-3 fully-sharded params: replicated == ZeRO-1/2 == ZeRO-3, end to end.
+
+Extends tests/test_zero_optimizer.py to ``zero_level=3``: the bf16 working
+params persist as 1/dp chunk trees (``zero3_init``) and each layer's weight
+tree is all-gathered just-in-time inside the layer loop
+(models/_transformer.run_layers ``chunk_meta``), re-gathered in the backward
+by per-layer remat, with grads arriving as per-layer reduce-scattered chunks
+(the gather transposes). The three modes must agree on the loss trajectory
+AND the final params — including through an overflow-skipped step, which
+must leave every rank's chunk shards bit-identical to their pre-step
+buffers — on the scan and unroll layer drives, and on the
+tp x pp x dp pipelined hybrid (slow-marked).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.distributed import gather_chunked_tree
+from apex_tpu.parallel import collectives
+from apex_tpu.parallel.distributed import allreduce_gradients
+
+N = 8
+POISON_STEP = 1  # the forced-overflow (skipped) step of the 3-step sandwich
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("data",))
+
+
+def _gpt(unroll):
+    cfg = GPTConfig(
+        vocab_size=128, hidden_size=32, num_layers=2,
+        num_attention_heads=4, max_seq_len=16, hidden_dropout=0.0,
+        axis=None, compute_dtype=jnp.bfloat16, remat=False,
+        unroll_layers=unroll)
+    return GPTModel(cfg)
+
+
+def _batch(mesh):
+    toks = jax.random.randint(jax.random.PRNGKey(1), (N * 2, 16), 0, 128)
+    tgts = jnp.roll(toks, -1, axis=-1)
+    put = lambda a: jax.device_put(a, NamedSharding(mesh, P("data")))  # noqa: E731
+    return put(toks), put(tgts)
+
+
+@pytest.mark.parametrize("unroll", [False, True], ids=["scan", "unroll"])
+def test_zero3_gpt_matches_replicated_and_zero2(mesh, unroll):
+    """3-step sandwich (normal, overflow-skipped, normal) on identical
+    batches: replicated, ZeRO-1/2 and ZeRO-3 must produce the same losses
+    and loss-scale trajectory, equivalent final params, and the skipped
+    step must leave the ZeRO-3 chunk shards bit-identical per rank.
+
+    The overflow is injected by ADDING an inf scalar to every grad leaf
+    inside the compiled step (finite + inf = inf, no NaNs), so the same
+    jit drives normal and skipped steps deterministically on every path.
+    """
+    model = _gpt(unroll)
+    policy = amp.get_policy("O2")
+    full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    pspecs = jax.tree.map(lambda _: P(), full)
+    data_spec = P("data")
+    toks, tgts = _batch(mesh)
+    poisons = [jnp.float32(jnp.inf) if t == POISON_STEP else jnp.float32(0)
+               for t in range(3)]
+
+    def run(mode):
+        # lr 1e-3 bounds the bf16-noise drift between the paths' differing
+        # reduction orders (test_zero_optimizer.py's measured rationale)
+        mp_opt = amp.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-3), policy,
+            zero_axis=None if mode == "repl" else "data",
+            zero_level=3 if mode == "zero3" else 2,
+            gather_dtype="bf16" if mode == "zero2" else None)
+
+        if mode == "zero3":
+            z3 = mp_opt.zero3_init(full, mesh, pspecs)
+            layer_meta = z3.meta.subtree("layers")
+            rest_meta = z3.meta.select(
+                [k for k in z3.meta.shapes if k != "layers"])
+
+            def zstep(p, s, tk, tg, poison):
+                rest_c = {k: v for k, v in p.items() if k != "layers"}
+
+                def scaled(rest_c, layer_c):
+                    rest = gather_chunked_tree(rest_c, rest_meta)
+                    return model.loss(
+                        dict(rest, layers=layer_c), tk, tg,
+                        layer_chunk_meta=layer_meta) * s.scaler.loss_scale
+
+                loss, (rg, lg) = jax.value_and_grad(scaled, argnums=(0, 1))(
+                    rest_c, p["layers"])
+                g = jax.tree.map(lambda x: x + poison, dict(rg, layers=lg))
+                new_p, new_s, m = mp_opt.apply_gradients(s, p, g)
+                return new_p, new_s, collectives.pmean(loss, "data"), m
+
+            step = jax.jit(jax.shard_map(
+                zstep, mesh=mesh,
+                in_specs=(z3.param_specs, z3.state_specs, data_spec,
+                          data_spec, P()),
+                out_specs=(z3.param_specs, z3.state_specs, P(), P()),
+                check_vma=False))
+            p, s = z3.params, z3.opt_state
+        elif mode == "zero2":
+            opt_state, sspecs = mp_opt.zero_init(full, mesh, pspecs)
+
+            def zstep(p, s, tk, tg, poison):
+                def scaled(p):
+                    return model.loss(p, tk, tg) * s.scaler.loss_scale
+
+                loss, g = jax.value_and_grad(scaled)(p)
+                g = jax.tree.map(lambda x: x + poison, g)
+                new_p, new_s, m = mp_opt.apply_gradients(s, p, g)
+                return new_p, new_s, collectives.pmean(loss, "data"), m
+
+            step = jax.jit(jax.shard_map(
+                zstep, mesh=mesh,
+                in_specs=(pspecs, sspecs, data_spec, data_spec, P()),
+                out_specs=(pspecs, sspecs, P(), P()), check_vma=False))
+            p, s = full, opt_state
+        else:
+            opt_state = mp_opt.init(full)
+
+            def grads_fn(p, tk, tg, scale, poison):
+                def scaled(p):
+                    return model.loss(p, tk, tg) * scale
+
+                loss, g = jax.value_and_grad(scaled)(p)
+                g = allreduce_gradients(g, ("data",))
+                g = jax.tree.map(lambda x: x + poison, g)
+                return collectives.pmean(loss, "data"), g
+
+            shard_fn = jax.shard_map(
+                grads_fn, mesh=mesh,
+                in_specs=(pspecs, data_spec, data_spec, P(), P()),
+                out_specs=(P(), pspecs), check_vma=False)
+
+            @jax.jit
+            def step(p, s, tk, tg, poison):
+                loss, g = shard_fn(p, tk, tg, s.scaler.loss_scale, poison)
+                new_p, new_s, m = mp_opt.apply_gradients(s, p, g)
+                return new_p, new_s, loss, m
+
+            p, s = full, opt_state
+
+        losses, scales, founds = [], [], []
+        pre_poison = None
+        for t in range(3):
+            if t == POISON_STEP and mode == "zero3":
+                pre_poison = jax.tree.map(np.asarray, p)
+            p, s, loss, m = step(p, s, toks, tgts, poisons[t])
+            losses.append(float(loss) / float(s.scaler.loss_scale)
+                          if t != POISON_STEP
+                          else float(loss))  # scale halved after the skip
+            scales.append(float(m["loss_scale"]))
+            founds.append(bool(m["found_inf"]))
+            if t == POISON_STEP and mode == "zero3":
+                # the skip leaves every rank's chunk shards bit-identical
+                for a, b in zip(jax.tree.leaves(pre_poison),
+                                jax.tree.leaves(jax.tree.map(np.asarray, p))):
+                    np.testing.assert_array_equal(a, b)
+        if mode == "zero3":
+            p = mp_opt.zero3_materialize(z3, mesh, pspecs, param_chunks=p)
+        return p, losses, scales, founds
+
+    results = {mode: run(mode) for mode in ("repl", "zero2", "zero3")}
+    p_ref, l_ref, sc_ref, f_ref = results["repl"]
+    assert f_ref == [False, True, False]
+    assert sc_ref[POISON_STEP] == sc_ref[0] / 2  # the skip halved the scale
+    for mode in ("zero2", "zero3"):
+        p_m, l_m, sc_m, f_m = results[mode]
+        assert f_m == f_ref and sc_m == sc_ref, mode
+        # the poisoned step's raw loss is scaled by the pre-skip scale on
+        # every path; compare it at that scale
+        np.testing.assert_allclose(l_m, l_ref, rtol=2e-3, err_msg=mode)
+        key = lambda kv: str(kv[0])  # noqa: E731
+        for (ka, a), (_, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(p_ref), key=key),
+                sorted(jax.tree_util.tree_leaves_with_path(p_m), key=key)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-2, atol=2e-2, err_msg=f"{mode}:{ka}")
+
+
+def test_zero3_init_shapes_specs_and_materialize_roundtrip(mesh):
+    """zero3_init: stacked layer leaves chunk PER ROW ((L, k), each rank
+    holding its (L, k/N) shard), non-layer leaves 1-D; state specs follow
+    by rank; and zero3_materialize restores the exact bf16 params (the
+    chunk layout is pure slicing — no arithmetic, so bit-exact)."""
+    model = _gpt(unroll=False)
+    policy = amp.get_policy("O2")
+    full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    pspecs = jax.tree.map(lambda _: P(), full)
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-3), policy, zero_axis="data", zero_level=3)
+    z3 = mp_opt.zero3_init(full, mesh, pspecs)
+
+    from apex_tpu.optimizers.distributed import chunk_size
+
+    L = model.cfg.num_layers
+    qkv = z3.params["layers"]["qkv"]["kernel"]
+    row = full["layers"]["qkv"]["kernel"][0].size
+    assert qkv.shape == (L, chunk_size(row, N) * N)
+    assert {s.data.shape for s in qkv.addressable_shards} \
+        == {(L, qkv.shape[1] // N)}
+    # masters mirror the chunk layout in fp32
+    assert z3.opt_state.master["layers"]["qkv"]["kernel"].shape == qkv.shape
+    assert z3.opt_state.master["layers"]["qkv"]["kernel"].dtype \
+        == jnp.float32
+    # non-layer leaves are 1-D chunks over every mesh axis
+    wte = z3.params["embedding"]["embedding"]
+    assert wte.ndim == 1
+    assert {s.data.shape for s in wte.addressable_shards} \
+        == {(wte.shape[0] // N,)}
+    # exact round-trip
+    back = mp_opt.zero3_materialize(z3, mesh, pspecs)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero3_wiring_validation():
+    """Level/axis validation fails loudly: zero_level=3 without an axis,
+    zero_init at level 3 (must use zero3_init), zero3_init below level 3,
+    and out-of-range levels."""
+    policy = amp.get_policy("O2")
+    with pytest.raises(ValueError, match="zero_level=3 requires zero_axis"):
+        amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-3), policy,
+                                    zero_level=3)
+    with pytest.raises(ValueError, match="zero_level must be"):
+        amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-3), policy,
+                                    zero_axis="data", zero_level=4)
+    z3 = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-3), policy,
+                                     zero_axis="data", zero_level=3)
+    with pytest.raises(ValueError, match="zero3_init"):
+        z3.zero_init({"w": jnp.ones((8,), jnp.bfloat16)}, None, None)
+    z2 = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-3), policy,
+                                     zero_axis="data")
+    with pytest.raises(ValueError, match="requires zero_level=3"):
+        z2.zero3_init({"w": jnp.ones((8,), jnp.bfloat16)}, None, None)
+
+
+def test_zero3_step_passes_gather_tripwire(mesh):
+    """The real ZeRO-3 GPT step traces clean under
+    lint.trace.zero3_gather_hazards — per-layer gathers only, no
+    model-sized bulk param gather — while the level-2 wiring (bulk
+    post-update gather) is exactly what the tripwire exists to catch in
+    a step claiming fully-sharded params."""
+    from apex_tpu.lint.trace import zero3_gather_hazards
+
+    model = _gpt(unroll=True)
+    policy = amp.get_policy("O2")
+    full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+    total = sum(x.size for x in jax.tree.leaves(full))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-3), policy, zero_axis="data", zero_level=3)
+    meta = mp_opt.zero3_meta(full)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jnp.zeros((2, 16), jnp.int32)
+
+    def z3_step(p):
+        chunks = mp_opt.zero3_shard(p)
+        rest_c = {k: v for k, v in chunks.items() if k != "layers"}
+
+        def scaled(rest_c, layer_c):
+            rest = gather_chunked_tree(rest_c, rest_meta)
+            return model.loss(dict(rest, layers=layer_c), toks, toks,
+                              layer_chunk_meta=layer_meta)
+
+        _, (rg, lg) = jax.value_and_grad(scaled, argnums=(0, 1))(
+            rest_c, chunks["layers"])
+        st = mp_opt.init(p)
+        return mp_opt.apply_gradients(st, chunks, dict(rg, layers=lg))[0]
+
+    # the embedding (vocab x hidden) dominates this tiny model, so the
+    # model-sized threshold must sit above it: only a whole-stack layer
+    # gather (or a full-model gather) counts as bulk here
+    rep = zero3_gather_hazards(
+        z3_step, full, axes={"data": N},
+        min_model_elems=full["embedding"]["embedding"].size + 1)
+    assert not rep["hazard"], rep
+    assert rep["layer_gathers"] >= model.cfg.num_layers
+
+
+@pytest.mark.slow
+def test_zero3_hybrid_tp_pp_dp():
+    """ZeRO-3 through build_zero_train_step on the tp=2 x sp x pp=2 x dp=2
+    hybrid: loss parity with replicated and ZeRO-2 on the same mesh and
+    batches. Heavyweight (three pipelined compiles): slow-marked;
+    dryrun_multichip(8) smokes the same composition in the gate."""
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.parallel.distributed import allreduce_gradients_by_spec
+    from apex_tpu.transformer.amp import build_zero_train_step
+    from apex_tpu.transformer.pipeline_parallel import (
+        prepare_pipelined_model,
+    )
+
+    hybrid = mesh_lib.make_virtual_mesh(
+        8, tensor_model_parallel_size=2, pipeline_model_parallel_size=2)
+    try:
+        cfg = GPTConfig(
+            vocab_size=128, hidden_size=64, num_layers=4,
+            num_attention_heads=4, max_seq_len=32, hidden_dropout=0.0,
+            axis=mesh_lib.AXIS_MODEL, sequence_parallel=True,
+            compute_dtype=jnp.bfloat16, remat=True)
+        model = GPTModel(cfg)
+        policy = amp.get_policy("O2")
+        full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
+        specs, params, pipe_loss = prepare_pipelined_model(
+            model, full, hybrid, num_microbatches=2)
+        rest_specs = {k: v for k, v in specs.items() if k != "layers"}
+        layer_specs = specs["layers"]
+        grad_axes = mesh_lib.get_gradient_reduction_axes()
+        data_spec = P(mesh_lib.AXIS_DATA)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+        tgts = jnp.roll(toks, -1, axis=-1)
+        put = lambda a: jax.device_put(  # noqa: E731
+            a, NamedSharding(hybrid, data_spec))
+        toks, tgts = put(toks), put(tgts)
+
+        def losses_for(level):
+            mp_opt = amp.MixedPrecisionOptimizer(
+                FusedAdam(lr=1e-2), policy,
+                zero_axis=mesh_lib.AXIS_DATA if level else None,
+                zero_level=level or 2,
+                gather_dtype="bf16" if level else None)
+            if level == 3:
+                z3 = mp_opt.zero3_init(params, hybrid, specs)
+                step = build_zero_train_step(
+                    mp_opt, hybrid, None, None, None,
+                    rest_specs=rest_specs, layer_specs=layer_specs,
+                    grad_axes=grad_axes, data_spec=data_spec,
+                    zero_axis=mesh_lib.AXIS_DATA,
+                    zero3=z3, model=model, num_microbatches=2)
+                p, s = z3.params, z3.opt_state
+            elif level == 2:
+                opt_state, sspecs = mp_opt.zero_init(params, hybrid, specs)
+                step = build_zero_train_step(
+                    mp_opt, hybrid, specs, sspecs, pipe_loss,
+                    rest_specs=rest_specs, layer_specs=layer_specs,
+                    grad_axes=grad_axes, data_spec=data_spec,
+                    zero_axis=mesh_lib.AXIS_DATA)
+                p, s = params, opt_state
+            else:
+                opt_state = mp_opt.init(params)
+
+                def sstep(p, tk, tg, scale):
+                    rest = {k: v for k, v in p.items() if k != "layers"}
+
+                    def scaled_loss(rest, layers):
+                        return pipe_loss(rest, layers, tk, tg) * scale
+
+                    loss, (rg, lg) = jax.value_and_grad(
+                        scaled_loss, argnums=(0, 1))(rest, p["layers"])
+                    rg = allreduce_gradients_by_spec(rg, rest_specs)
+                    lg = allreduce_gradients_by_spec(lg, layer_specs)
+                    return (collectives.pmean(loss, grad_axes),
+                            dict(rg, layers=lg))
+
+                shard_fn = jax.shard_map(
+                    sstep, mesh=hybrid,
+                    in_specs=(specs, data_spec, data_spec, P()),
+                    out_specs=(P(), specs), check_vma=False)
+
+                @jax.jit
+                def step(p, s, tk, tg):
+                    loss, g = shard_fn(p, tk, tg, s.scaler.loss_scale)
+                    new_p, new_s, m = mp_opt.apply_gradients(s, p, g)
+                    return new_p, new_s, loss, m
+
+                p, s = params, opt_state
+
+            out = []
+            for _ in range(2):
+                p, s, loss, _ = step(p, s, toks, tgts)
+                # build_zero_train_step returns the UNSCALED loss
+                out.append(float(loss) / (float(s.scaler.loss_scale)
+                                          if level == 0 else 1.0))
+            return out
+
+        l_repl = losses_for(0)
+        np.testing.assert_allclose(losses_for(2), l_repl, rtol=2e-3)
+        np.testing.assert_allclose(losses_for(3), l_repl, rtol=2e-3)
+    finally:
+        mesh_lib.destroy_model_parallel()
