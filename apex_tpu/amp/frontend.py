@@ -48,11 +48,20 @@ class MPOptState(NamedTuple):
     semantics) ``master`` is ALWAYS present and holds this rank's 1-D fp32
     chunk tree (1/n of every leaf); ``inner`` is built over the chunks, so
     the whole optimizer footprint is 1/n per rank.
+
+    ``residual`` (None unless ``reduce_dtype`` arms the quantized grad
+    reduce-scatter) is the error-feedback state riding the sharded trees:
+    ``{"err": <tree of flat fp32 leaves in the chunk layout — this rank's
+    send error per destination chunk, concatenated>[, "key": <per-rank
+    PRNG key when stochastic rounding is armed>]}``. Like masters and
+    moments it is per-rank state behind the universal chunk specs, and an
+    overflow-skipped step leaves it bit-identical on every rank.
     """
 
     inner: Any
     master: Any
     scaler: LossScaler
+    residual: Any = None
 
 
 class Zero3Setup(NamedTuple):
@@ -83,9 +92,31 @@ def _spec_axis_names(entry):
 def _canon_gather_dtype(dt):
     if dt is None:
         return None
-    if isinstance(dt, str) and dt.lower() in ("bf16", "bfloat16"):
-        return jnp.dtype(jnp.bfloat16)
-    return jnp.dtype(dt)
+    if isinstance(dt, str):
+        low = dt.lower()
+        if low in ("bf16", "bfloat16"):
+            return jnp.dtype(jnp.bfloat16)
+        if low in ("e5m2", "fp8", "float8_e5m2"):
+            # the reference's e5m2-compressed allgather spelling: a bare
+            # cast-and-gather at 1 B/elem (no scales — the float8 dynamic
+            # range carries the value; use "int8" for the scaled wire)
+            return jnp.dtype(jnp.float8_e5m2)
+        if low == "int8":
+            # quantized param gather: per-chunk fp32 scale side-channel,
+            # decode after the collective (parallel/quantize.py;
+            # optimizers.distributed.gather_leaf routes on the int dtype)
+            return jnp.dtype(jnp.int8)
+    canon = jnp.dtype(dt)
+    if jnp.issubdtype(canon, jnp.integer) and canon != jnp.dtype(jnp.int8):
+        # the only integer wire is the quantized int8 path — a wider int
+        # would silently route through the 8-bit encode (gather_leaf
+        # dispatches on integer-ness), delivering less precision than
+        # the name promises
+        raise ValueError(
+            f"unsupported integer gather_dtype {dt!r}: the quantized "
+            f"param-gather wire is 'int8' only (parallel/quantize.py); "
+            f"use 'int8', 'bf16', or a float dtype")
+    return canon
 
 
 def _scaler_from_policy(policy: _precision.Policy, **scaler_kwargs) -> LossScaler:
@@ -124,6 +155,8 @@ class MixedPrecisionOptimizer:
         zero_axis: Optional[str] = None,
         zero_level: int = 2,
         gather_dtype: Optional[Any] = None,
+        reduce_dtype: Optional[str] = None,
+        stochastic_rounding: bool = False,
         stacked_keys: Tuple[str, ...] = ("layers",),
         **scaler_kwargs,
     ):
@@ -171,6 +204,49 @@ class MixedPrecisionOptimizer:
         if self.gather_dtype is not None and zero_axis is None:
             raise ValueError("gather_dtype only applies with zero_axis set "
                              "(it is the ZeRO param-gather wire dtype)")
+        if (self.gather_dtype is not None and self.zero_level >= 3
+                and jnp.issubdtype(self.gather_dtype, jnp.integer)):
+            raise ValueError(
+                "gather_dtype='int8' does not compose with zero_level=3: "
+                "the ZeRO-3 per-layer gathers sit INSIDE the differentiated "
+                "region and the int8 encode's round() would zero the "
+                "gradients flowing through its AD transpose — quantize the "
+                "level-1/2 post-update gather, or use 'bf16' for the JIT "
+                "gathers")
+        #: wire dtype of the GRADIENT reduce-scatter under ``zero_axis``
+        #: ("int8" | "e5m2"): the fp32 psum_scatter becomes the quantized
+        #: all_to_all decode-then-accumulate pair (parallel/quantize.py) —
+        #: 1 B/elem on the wire plus a tiny fp32 per-chunk scale
+        #: side-channel — with a sender-side error-feedback residual
+        #: carried in :class:`MPOptState.residual` so quantization errors
+        #: telescope instead of accumulating. The decode-accumulate and
+        #: the /n averaging stay exact fp32. Memory note: the residual is
+        #: per-rank fp32 state at the FULL (padded) leaf size — the
+        #: standard EF/1-bit-Adam trade of state bytes for wire bytes;
+        #: arm it when the interconnect, not HBM, is the bottleneck.
+        from apex_tpu.parallel.quantize import canon_wire_dtype
+
+        self.reduce_dtype = canon_wire_dtype(reduce_dtype)
+        if self.reduce_dtype is not None and zero_axis is None:
+            raise ValueError("reduce_dtype only applies with zero_axis set "
+                             "(it is the ZeRO grad reduce-scatter wire "
+                             "dtype)")
+        if self.reduce_dtype is not None and self.zero_level >= 3:
+            raise ValueError(
+                "reduce_dtype does not compose with zero_level=3 yet: the "
+                "ZeRO-3 grads reduce-scatter inside the per-layer gather "
+                "transposes (optimizers.distributed.gather_leaf AD), not "
+                "in apply_gradients — quantize at level 1/2, or use "
+                "gather_dtype for the JIT gathers")
+        #: int8-only uniform dither before the round (zero-mean per-element
+        #: error) — an option on top of, not a substitute for, the
+        #: error-feedback residual. Carries a per-rank PRNG key in
+        #: ``MPOptState.residual["key"]``.
+        self.stochastic_rounding = bool(stochastic_rounding)
+        if self.stochastic_rounding and self.reduce_dtype != "int8":
+            raise ValueError("stochastic_rounding requires "
+                             "reduce_dtype='int8' (e5m2's ulp is value-"
+                             "dependent; None has nothing to round)")
         #: when True, ``apply_gradients`` metrics include the global L2 norm
         #: of the unscaled grads — the journal hook (monitor/journal.py).
         #: Off by default: the extra tree reduction, while small next to the
@@ -219,6 +295,27 @@ class MixedPrecisionOptimizer:
 
         return jax.tree.map(chunk, params, self._stacked_tree(params))
 
+    def _init_residual(self, model_params):
+        """The error-feedback state for the quantized grad reduce-scatter
+        (None when ``reduce_dtype`` is unset, so the state structure —
+        and every ``reduce_dtype=None`` trace — is bit-identical to the
+        unquantized path). Must run inside shard_map (or an axis_env
+        trace) binding the zero axis, like :meth:`init`."""
+        if self.reduce_dtype is None:
+            return None
+        from apex_tpu.optimizers.distributed import chunk_size
+
+        n = lax.axis_size(self.zero_axis)
+        err = jax.tree.map(
+            lambda p: jnp.zeros((chunk_size(p.size, n) * n,), jnp.float32),
+            model_params)
+        residual = {"err": err}
+        if self.stochastic_rounding:
+            # per-rank dither stream: senders round independently
+            residual["key"] = jax.random.fold_in(
+                jax.random.PRNGKey(0), lax.axis_index(self.zero_axis))
+        return residual
+
     def zero3_shard(self, model_params) -> Any:
         """The persistent ZeRO-3 working-param chunk tree (model dtypes):
         stacked layer leaves become ``(L, k)`` per-row chunks, everything
@@ -244,6 +341,7 @@ class MixedPrecisionOptimizer:
                 inner=self.inner.init(master),
                 master=master,
                 scaler=_scaler_from_policy(self.policy, **self._scaler_kwargs),
+                residual=self._init_residual(model_params),
             )
         if self.policy.master_weights:
             master = _precision.upcast_params(model_params)
@@ -366,10 +464,39 @@ class MixedPrecisionOptimizer:
 
         axis = self.zero_axis
         n = lax.axis_size(axis)
-        # the scatter IS the data-axis gradient reduction; /n is the same
-        # averaging factor allreduce_gradients applies
-        g_chunks = jax.tree.map(
-            lambda g: scatter_chunk(g, n, axis) / n, grads32)
+        new_residual = state.residual
+        if self.reduce_dtype is not None:
+            # quantized reduce-scatter (parallel/quantize.py): encoded
+            # all_to_all + fp32 decode-then-accumulate — SUM semantics
+            # identical to scatter_chunk, 1 B/elem on the wire. The
+            # error-feedback residual compensates next step's payload;
+            # its update is selected back on overflow below, with the
+            # masters, so a skipped step leaves it bit-identical per rank.
+            from apex_tpu.parallel.quantize import quantized_reduce_scatter
+
+            err_tree = state.residual["err"]
+            key = state.residual.get("key")
+            leaves, treedef = jax.tree.flatten(grads32)
+            err_leaves = treedef.flatten_up_to(err_tree)
+            if key is not None:
+                new_key, *subkeys = jax.random.split(key, len(leaves) + 1)
+            else:
+                new_key, subkeys = None, [None] * len(leaves)
+            pairs = [quantized_reduce_scatter(
+                g, n, axis, self.reduce_dtype, residual=e, key=k)
+                for g, e, k in zip(leaves, err_leaves, subkeys)]
+            g_chunks = treedef.unflatten([c / n for c, _ in pairs])
+            stepped_err = treedef.unflatten([e for _, e in pairs])
+            new_residual = {"err": stepped_err}
+            if new_key is not None:
+                # the key advances unconditionally (it is a dither stream,
+                # not model state): ranks stay in lockstep through skips
+                new_residual["key"] = new_key
+        else:
+            # the scatter IS the data-axis gradient reduction; /n is the
+            # same averaging factor allreduce_gradients applies
+            g_chunks = jax.tree.map(
+                lambda g: scatter_chunk(g, n, axis) / n, grads32)
 
         updates, stepped_inner = self.inner.update(
             g_chunks, state.inner, state.master, **update_kwargs)
@@ -378,6 +505,10 @@ class MixedPrecisionOptimizer:
             lambda a, b: jnp.where(found_inf, b, a), new, old)
         new_master = keep(stepped_master, state.master)
         new_inner = keep(stepped_inner, state.inner)
+        if self.reduce_dtype is not None:
+            new_residual = dict(
+                new_residual,
+                err=keep(new_residual["err"], state.residual["err"]))
 
         # all-gather the updated params; with gather_dtype the payload is
         # compressed on the wire, then stored back in each param's dtype
@@ -404,7 +535,9 @@ class MixedPrecisionOptimizer:
             metrics["grad_norm_by_group"] = group_grad_norms(
                 g_chunks, psum_axis=axis,
                 extra_axes=self._zero_norm_axes)
-        return new_model, MPOptState(new_inner, new_master, new_scaler), metrics
+        return (new_model,
+                MPOptState(new_inner, new_master, new_scaler, new_residual),
+                metrics)
 
     # -- the ZeRO-3 step: no scatter (grads arrive as chunks), no gather ----
     def _apply_zero3(self, state, param_chunks, grads32, found_inf,
@@ -513,12 +646,24 @@ class MixedPrecisionOptimizer:
         chunks = treedef.unflatten(
             [chunk_struct(p, s) for p, s in zip(leaves, spec_leaves)])
         scaler = _scaler_from_policy(self.policy, **self._scaler_kwargs)
+        residual = None
+        if self.reduce_dtype is not None:
+            # error-feedback state: per-rank flat fp32 leaves in the chunk
+            # layout (n chunks concatenated — this rank's send error per
+            # destination), mirroring _init_residual exactly
+            residual = {"err": jax.tree.map(
+                lambda c: jax.ShapeDtypeStruct((c.shape[0] * n,),
+                                               jnp.float32), chunks)}
+            if self.stochastic_rounding:
+                residual["key"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
 
         def fake_init(c):
             return MPOptState(inner=self.inner.init(c), master=c,
                               scaler=scaler)
 
-        return jax.eval_shape(fake_init, chunks)
+        # residual structs attach AFTER eval_shape: they are already
+        # abstract (ShapeDtypeStructs), not closure constants to trace
+        return jax.eval_shape(fake_init, chunks)._replace(residual=residual)
 
     def zero_state_specs(self, state, mesh):
         """shard_map specs for a ZeRO :class:`MPOptState` (or its abstract

@@ -36,6 +36,12 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   params must stay 1/n chunks gathered just-in-time per layer
   (models/_transformer.run_layers ``chunk_meta``); a whole-stack or
   post-update bulk gather silently returns peak HBM to O(model).
+- ``quantized-comm``    (:func:`quantized_comm_hazards`) -- a step that
+  requests a quantized grad reduce (``MixedPrecisionOptimizer
+  reduce_dtype``) but whose jaxpr still moves a >= 2-byte bulk reduce
+  payload on the zero axis (the fp32 psum_scatter survived), or that
+  quantizes grads with no error-feedback residual leaf in the optimizer
+  state -- bias then accumulates instead of telescoping.
 
 All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
 device work) and return plain dicts/lists of findings shaped like engine
@@ -586,6 +592,118 @@ def zero3_gather_hazards(fn, *args,
         "bulk_gathers": n_bulk,
         "layer_gathers": sum(census["per_layer"].values()),
         "min_model_elems": int(min_model_elems),
+        "findings": findings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# quantized-collective tripwire
+# ---------------------------------------------------------------------------
+
+
+def quantized_comm_census(jaxpr, zero_axis: str,
+                          min_bulk_elems: int = 1 << 12) -> Dict[str, Any]:
+    """Census of BULK reduce traffic (``reduce_scatter``/``all_to_all``
+    equations with an operand of >= ``min_bulk_elems`` elements) over
+    ``zero_axis``, keyed by the payload's wire itemsize in bytes — so an
+    int8/e5m2-encoded reduce tallies under ``"1"`` and a surviving fp32
+    payload under ``"4"``. The fp32 per-chunk scale side-channels are n
+    elements each (far below the bulk floor) and never pollute the table."""
+    import numpy as np
+
+    by_itemsize: Dict[str, Counter] = {}
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in ("reduce_scatter", "all_to_all"):
+            continue
+        if zero_axis not in _eqn_axis_names(eqn):
+            continue
+        bulk_ops = [v for v in eqn.invars
+                    if _aval_of(v) is not None
+                    and int(getattr(_aval_of(v), "size", 0) or 0)
+                    >= min_bulk_elems]
+        if not bulk_ops:
+            continue
+        itemsize = max(int(np.dtype(_aval_of(v).dtype).itemsize)
+                       for v in bulk_ops)
+        by_itemsize.setdefault(str(itemsize), Counter())[name] += 1
+    return {k: dict(v) for k, v in sorted(by_itemsize.items())}
+
+
+def quantized_comm_hazards(fn, *args,
+                           zero_axis: str = "data",
+                           axes: Optional[Dict[str, int]] = None,
+                           residual: Any = "unchecked",
+                           min_bulk_elems: int = 1 << 12,
+                           **kwargs) -> Dict[str, Any]:
+    """Verify a step that REQUESTS a quantized grad reduce actually moves
+    its bulk reduce payload at the 1-byte wire dtype.
+
+    Traces ``fn(*args)`` under ``axes`` (omit when ``fn`` binds its own
+    axes via shard_map) and censuses bulk reduce traffic
+    (``reduce_scatter``/``all_to_all``, the ZeRO reduction verbs —
+    ``QUANTIZED_REDUCE_PRIMS``, parallel/collectives.py) on ``zero_axis``
+    by wire itemsize. Under ``MixedPrecisionOptimizer(reduce_dtype=...)``
+    every bulk reduce payload must be 1 byte/elem (the encoded
+    ``all_to_all`` pair of parallel/quantize.py; only the tiny fp32 scale
+    side-channels ride wider, below the bulk floor) — a surviving >= 2-byte
+    bulk payload means the quantization silently regressed to the fat wire,
+    and XLA compiles the regression without complaint.
+
+    ``residual`` guards the second silent failure mode: quantizing GRADS
+    with no error-feedback state accumulates bias instead of telescoping
+    it. Pass the optimizer state's residual tree (``MPOptState.residual``)
+    — a finding is raised when it is None or lacks the ``"err"`` chunk
+    tree. Leave the default to skip the check (activation-only traffic
+    carries no residual by design).
+
+    Returns ``{hazard, census, fat_reduces, findings}`` — call-site counts
+    per trace, like :func:`zero_redundancy_hazards`.
+    """
+    import jax
+
+    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
+        jaxpr = fn.jaxpr
+    else:
+        env = list(axes.items()) if axes else None
+        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    census = quantized_comm_census(jaxpr, zero_axis,
+                                   min_bulk_elems=min_bulk_elems)
+    fat = sum(n for size, verbs in census.items() if int(size) > 1
+              for n in verbs.values())
+    thin = sum(n for size, verbs in census.items() if int(size) == 1
+               for n in verbs.values())
+    findings = []
+    if fat:
+        findings.append({
+            "rule": "quantized-comm-fat-wire",
+            "message": (
+                f"step jaxpr carries {fat} bulk reduce payload(s) on the "
+                f"'{zero_axis}' axis at >= 2 bytes/elem in a step that "
+                f"requests a quantized grad reduce -- the fp32 "
+                f"psum_scatter survived (or an all_to_all shipped an "
+                f"unencoded payload); route it through "
+                f"parallel/quantize.quantized_reduce_scatter so the wire "
+                f"moves 1 B/elem plus the fp32 scale side-channel"),
+            "verb": "reduce_scatter", "extra": fat,
+        })
+    if residual != "unchecked" and (
+            not isinstance(residual, dict) or "err" not in residual):
+        findings.append({
+            "rule": "quantized-comm-no-residual",
+            "message": (
+                "quantized GRAD reduce with no error-feedback residual "
+                "state: MPOptState.residual lacks the 'err' chunk tree, so "
+                "per-step quantization error accumulates as bias instead "
+                "of telescoping (the EF/1-bit-Adam construction, "
+                "parallel/quantize.py module doc)"),
+            "verb": "all_to_all", "extra": 1,
+        })
+    return {
+        "hazard": bool(findings),
+        "census": census,
+        "fat_reduces": fat,
+        "quantized_reduces": thin,
         "findings": findings,
     }
 

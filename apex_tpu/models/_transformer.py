@@ -142,6 +142,29 @@ class TransformerBase:
         # between them (LN, dropout, residual) runs on (b, s/tp, h) shards.
         # Serial (axis=None) ignores the knob — one code path.
         self._sp = bool(getattr(c, "sequence_parallel", False)) and c.axis is not None
+        # Quantized wire dtype of the sequence-parallel conjugates
+        # (cfg.activation_comm_dtype -> the encode/decode pair of
+        # parallel/quantize.py): activations quantize more safely than
+        # grads — fresh values every step, per-shard scales bound the
+        # error — so no residual state rides along (quantize.py module
+        # doc). Only meaningful when the conjugates exist at all.
+        self._acd = getattr(c, "activation_comm_dtype", None)
+        if self._acd is not None:
+            from apex_tpu.parallel.quantize import canon_wire_dtype
+
+            self._acd = canon_wire_dtype(self._acd)
+            if c.axis is None:
+                # serial twin convention (same as sequence_parallel, which
+                # is "ignored when axis is None"): the serial build of a
+                # sharded config must run, one code path — there is no
+                # wire to quantize
+                self._acd = None
+            elif not self._sp:
+                raise ValueError(
+                    "activation_comm_dtype requires sequence_parallel=True: "
+                    "the quantized wire dtype rides the sequence-parallel "
+                    "scatter/gather conjugates — plain-TP all-reduces have "
+                    "no encode/decode seam")
         if self._sp:
             # seq % tp == 0 is a runtime property (the axis size lives in
             # the mesh), but when the mesh is already up we can fail HERE
@@ -164,27 +187,27 @@ class TransformerBase:
         self._init = init
         self.embedding = tp.VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, axis=c.axis,
-            sequence_parallel=self._sp,
+            sequence_parallel=self._sp, comm_dtype=self._acd,
             params_dtype=c.params_dtype, init_method=init,
         )
         self.qkv = tp.ColumnParallelLinear(
             c.hidden_size, 3 * c.hidden_size, axis=c.axis, gather_output=False,
-            sequence_parallel=self._sp,
+            sequence_parallel=self._sp, comm_dtype=self._acd,
             params_dtype=c.params_dtype, init_method=init,
         )
         self.proj = tp.RowParallelLinear(
             c.hidden_size, c.hidden_size, axis=c.axis, input_is_parallel=True,
-            sequence_parallel=self._sp,
+            sequence_parallel=self._sp, comm_dtype=self._acd,
             params_dtype=c.params_dtype, init_method=out_init,
         )
         self.fc1 = tp.ColumnParallelLinear(
             c.hidden_size, c.ffn, axis=c.axis, gather_output=False,
-            sequence_parallel=self._sp,
+            sequence_parallel=self._sp, comm_dtype=self._acd,
             params_dtype=c.params_dtype, init_method=init,
         )
         self.fc2 = tp.RowParallelLinear(
             c.ffn, c.hidden_size, axis=c.axis, input_is_parallel=True,
-            sequence_parallel=self._sp,
+            sequence_parallel=self._sp, comm_dtype=self._acd,
             params_dtype=c.params_dtype, init_method=out_init,
         )
 

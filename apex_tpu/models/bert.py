@@ -58,6 +58,10 @@ class BertConfig:
     # the sequence back at entry (the [CLS] pooler and the tied decode see
     # the full sequence). Ignored when axis is None.
     sequence_parallel: bool = False
+    # Quantized wire dtype ("int8" | "e5m2") for the sequence-parallel
+    # activation conjugates (requires sequence_parallel=True) — see
+    # GPTConfig.activation_comm_dtype. None = exact wire.
+    activation_comm_dtype: Optional[str] = None
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     hidden_dropout: float = 0.1
@@ -211,7 +215,7 @@ class BertModel(TransformerBase):
                 # reduce-scatter there would double-count what copy_to's
                 # backward psum already summed.
                 h = tp.gather_from_sequence_parallel_region(
-                    h, c.axis, False)
+                    h, c.axis, False, self._acd)
             binary_logits = None
             if c.add_binary_head:
                 cls = h[:, 0]

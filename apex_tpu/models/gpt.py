@@ -65,6 +65,14 @@ class GPTConfig:
     # synchronous). Ignored when axis is None; requires max_seq_len
     # divisible by tp. No reference analog (apex predates Megatron SP).
     sequence_parallel: bool = False
+    # Quantized wire dtype ("int8" | "e5m2") for the sequence-parallel
+    # activation conjugates (requires sequence_parallel=True): the
+    # scatter/gather payloads encode to 1 B/elem with per-shard fp32
+    # scales riding a tiny side-channel (parallel/quantize.py), summed in
+    # fp32 after decode. Activations carry no error-feedback residual —
+    # fresh values every step bound the error by the per-shard scale.
+    # None = exact wire (the default; traces bit-identical to pre-knob).
+    activation_comm_dtype: Optional[str] = None
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     hidden_dropout: float = 0.1
@@ -291,7 +299,8 @@ class GPTModel(TransformerBase):
                     # the backward reduce-scatter sums the per-vocab-shard
                     # partial cotangents AND re-shards the sequence — the
                     # copy_to psum and the scatter in one conjugate
-                    h = tp.gather_from_sequence_parallel_region(h, c.axis)
+                    h = tp.gather_from_sequence_parallel_region(
+                        h, c.axis, True, self._acd)
                 else:
                     h = tp.copy_to_tensor_model_parallel_region(h, c.axis)
             logits = jnp.einsum("bsh,vh->bsv", h, wte)  # vocab-sharded logits

@@ -23,7 +23,7 @@ cost is the ``named_scope`` context, which exists at trace time only.
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Dict, List, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -38,29 +38,43 @@ def _axis_label(axis: AxisNames) -> str:
     return str(axis)
 
 
-def _tree_bytes(tree: Any) -> int:
-    """Payload bytes of a pytree of arrays/tracers (aval shape x itemsize)."""
+def _tree_bytes(tree: Any) -> Tuple[int, str]:
+    """``(payload bytes, wire dtype)`` of a pytree of arrays/tracers
+    (aval shape x itemsize). The dtype label is the leaves' common dtype
+    ("mixed" when a multi-dtype tree rides one collective) — the wire-
+    dtype dimension of the accounting, so an int8-quantized payload and
+    its fp32 scale side-channel tally as separate rows."""
     import jax
     import numpy as np
 
     total = 0
+    dtypes = set()
     for leaf in jax.tree.leaves(tree):
         try:
             size = int(np.prod(leaf.shape)) if leaf.shape else 1
             total += size * np.dtype(leaf.dtype).itemsize
+            dtypes.add(str(np.dtype(leaf.dtype)))
         except Exception:  # noqa: BLE001 - tokens, python scalars
             continue
-    return total
+    if not dtypes:
+        dtype = "none"
+    elif len(dtypes) == 1:
+        dtype = dtypes.pop()
+    else:
+        dtype = "mixed"
+    return total, dtype
 
 
 class CommAccount:
-    """Byte/count tallies per (verb, axis) collective call site."""
+    """Byte/count tallies per (verb, axis, wire dtype) collective call
+    site."""
 
     def __init__(self):
         self.records: List[Dict[str, Any]] = []
 
-    def add(self, verb: str, axis: str, nbytes: int):
-        self.records.append({"verb": verb, "axis": axis, "bytes": nbytes})
+    def add(self, verb: str, axis: str, nbytes: int, dtype: str = "none"):
+        self.records.append({"verb": verb, "axis": axis, "bytes": nbytes,
+                             "dtype": dtype})
 
     def _group(self, key: str) -> Dict[str, Dict[str, int]]:
         out: Dict[str, Dict[str, int]] = {}
@@ -77,12 +91,31 @@ class CommAccount:
     def by_verb(self) -> Dict[str, Dict[str, int]]:
         return self._group("verb")
 
+    def by_verb_dtype(self, axis: Optional[str] = None
+                      ) -> Dict[str, Dict[str, int]]:
+        """``{"<verb>[<dtype>]": {"bytes", "calls"}}`` — the wire-dtype
+        rollup: a quantized reduce books its int8 payload and its fp32
+        scale side-channel as distinct rows, so the 1/4-bytes compression
+        claim (and the side-channel's cost) read straight off the table.
+        ``axis`` restricts to one mesh axis (the evidence harnesses' view
+        of the data-axis wire)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for r in self.records:
+            if axis is not None and r["axis"] != axis:
+                continue
+            key = f"{r['verb']}[{r.get('dtype', 'none')}]"
+            row = out.setdefault(key, {"bytes": 0, "calls": 0})
+            row["bytes"] += r["bytes"]
+            row["calls"] += 1
+        return out
+
     def total_bytes(self) -> int:
         return sum(r["bytes"] for r in self.records)
 
     def summary(self) -> Dict[str, Any]:
         return {"total_bytes": self.total_bytes(),
-                "by_axis": self.by_axis(), "by_verb": self.by_verb()}
+                "by_axis": self.by_axis(), "by_verb": self.by_verb(),
+                "by_verb_dtype": self.by_verb_dtype()}
 
 
 @contextlib.contextmanager
@@ -112,7 +145,7 @@ def collective_scope(verb: str, axis: AxisNames, tree: Any):
 
     label = _axis_label(axis)
     if _ACTIVE:
-        nbytes = _tree_bytes(tree)
+        nbytes, dtype = _tree_bytes(tree)
         for acct in _ACTIVE:
-            acct.add(verb, label, nbytes)
+            acct.add(verb, label, nbytes, dtype)
     return jax.named_scope(f"comm:{verb}[{label}]")

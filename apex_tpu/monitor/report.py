@@ -217,6 +217,23 @@ def analyze(
     if comm:
         out["comm_bytes_by_axis"] = comm
 
+    # per-wire-dtype comm rollup (rows carrying comm_bytes_by_verb_dtype —
+    # CommAccount.by_verb_dtype tables from quantized-collective configs):
+    # a quantized reduce's int8 payload and its fp32 scale side-channel
+    # land as distinct "<verb>[<dtype>]" rows, so the compression ratio
+    # (and the side-channel's cost) read straight off the analysis
+    comm_dt: Dict[str, Dict[str, int]] = {}
+    for r in records:
+        table = r.get("comm_bytes_by_verb_dtype")
+        if not isinstance(table, dict):
+            continue
+        for key, row in table.items():
+            agg = comm_dt.setdefault(key, {"bytes": 0, "calls": 0})
+            agg["bytes"] += int(row.get("bytes", 0))
+            agg["calls"] += int(row.get("calls", 0))
+    if comm_dt:
+        out["comm_bytes_by_verb_dtype"] = comm_dt
+
     # MFU / roofline summary (records journaled with step costs armed)
     mfus = [r["mfu"] for r in steps if isinstance(r.get("mfu"), (int, float))]
     if mfus:
@@ -326,6 +343,11 @@ def render(analysis: Dict[str, Any], file=None) -> None:
         for axis, row in sorted(comm.items()):
             p(f"comm[{axis}]: {row['bytes'] / 1e6:.2f} MB over "
               f"{row['calls']} call site(s)")
+    comm_dt = analysis.get("comm_bytes_by_verb_dtype")
+    if comm_dt:
+        for key, row in sorted(comm_dt.items()):
+            p(f"comm {key}: {row['bytes'] / 1e6:.2f} MB over "
+              f"{row['calls']} call site(s)")
     osb = analysis.get("opt_state_bytes")
     if osb:
         p(f"opt state: {osb['last'] / 1e6:.1f} MB/rank "
@@ -358,6 +380,7 @@ def compare(
     *,
     threshold: float = 0.05,
     hbm_slack_bytes: int = 64 << 20,
+    loss_threshold: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Compare run B against baseline A; ``regressed`` iff B is worse.
 
@@ -371,6 +394,15 @@ def compare(
     ``param_bytes`` stamps must not grow past the threshold (a candidate
     that silently dropped ZeRO/ZeRO-3 re-replicates O(model) state at
     identical throughput — only these stamps would see it).
+
+    ``loss_threshold`` (off by default — timing gates must not fail on
+    stochastic loss noise) arms the CONVERGENCE check: B's final loss
+    must not exceed A's by more than this fraction of A's loss drop
+    (``first - last``; falls back to ``|last|`` when A never improved).
+    Scaling by the drop makes the tolerance mean "fraction of the
+    learning progress given back" — the machine gate for paired
+    fp32-wire vs quantized-wire training runs (the quantized-collectives
+    convergence bar, parallel/quantize.py).
     """
     ra, rb = analyze(a), analyze(b)
     checks: List[Dict[str, Any]] = []
@@ -419,6 +451,21 @@ def compare(
           (ra.get("loss") or {}).get("nonfinite_count", 0),
           (rb.get("loss") or {}).get("nonfinite_count", 0),
           worse=lambda va, vb: vb > va)
+    if loss_threshold is not None:
+        # convergence gate: final loss within loss_threshold x A's loss
+        # drop (docstring) — the tolerance is denominated in learning
+        # progress, so short runs with small absolute drops gate tightly
+        la = ra.get("loss") or {}
+        drop = None
+        if isinstance(la.get("first"), (int, float)) and isinstance(
+                la.get("last"), (int, float)):
+            drop = la["first"] - la["last"]
+            if drop <= 0:
+                drop = abs(la["last"]) or 1.0
+        check("loss_last", la.get("last"),
+              (rb.get("loss") or {}).get("last"),
+              worse=lambda va, vb: vb > va + loss_threshold * (
+                  drop if drop is not None else abs(va) or 1.0))
     # per-rank residency stamps (set_opt_state_bytes/set_param_bytes):
     # regression = the static footprint GROWS past the threshold — a
     # candidate that quietly dropped ZeRO(-3) re-replicates O(model)
@@ -457,13 +504,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                             "(default 0.05)")
         p.add_argument("--hbm-slack-mb", type=float, default=64.0,
                        help="allowed HBM-growth excess over baseline (MiB)")
+        p.add_argument("--loss-threshold", type=float, default=None,
+                       help="arm the convergence gate: candidate final loss "
+                            "must be within this fraction of the baseline's "
+                            "loss drop (off by default — see compare())")
         p.add_argument("--json", action="store_true",
                        help="print the full comparison as one JSON object")
         args = p.parse_args(argv[1:])
         res = compare(load(args.baseline), load(args.candidate),
                       threshold=args.threshold,
                       # MiB, matching compare()'s 64 << 20 default exactly
-                      hbm_slack_bytes=int(args.hbm_slack_mb * (1 << 20)))
+                      hbm_slack_bytes=int(args.hbm_slack_mb * (1 << 20)),
+                      loss_threshold=args.loss_threshold)
         if args.json:
             print(json.dumps(res))
         else:

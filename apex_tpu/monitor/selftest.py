@@ -26,7 +26,11 @@ that the monitor pieces stay importable and functional:
    and the ZeRO double-reduction tripwire (a bulk data-axis grad psum
    alongside a sharded optimizer; the decomposed scatter/gather passes),
    plus the ZeRO-3 bulk-gather tripwire (a model-sized param all_gather
-   in a fully-sharded step; per-layer JIT gathers pass).
+   in a fully-sharded step; per-layer JIT gathers pass), plus the
+   quantized-collective tripwire (a surviving fp32 bulk reduce payload in
+   a step that requests a quantized grad reduce, and a quantized grad
+   reduce with no error-feedback residual leaf; the encoded all_to_all
+   pair with a residual passes).
 
 Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
 proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
@@ -355,6 +359,28 @@ def _check_lint() -> dict:
                                             axes={"data": 8},
                                             model_elems=L * 512)
     assert not z3_ok["hazard"] and z3_ok["layer_gathers"] == L, z3_ok
+
+    # engine 2, quantized-collective tripwire: a surviving fp32 bulk
+    # reduce payload in a step that requests a quantized grad reduce is
+    # the fat-wire regression; the encoded all_to_all pair passes, and a
+    # quantized grad reduce with no residual leaf flags the EF check
+    from apex_tpu.parallel.quantize import quantized_reduce_scatter
+
+    qc_bad = lint_trace.quantized_comm_hazards(
+        lambda g: scatter_chunk(g, 8, "data") / 8, big, axes={"data": 8})
+    assert qc_bad["hazard"] and qc_bad["fat_reduces"] == 1, qc_bad
+
+    def qc_good(g):
+        chunk, _ = quantized_reduce_scatter(g, 8, "data", "int8")
+        return chunk / 8
+
+    qc_ok = lint_trace.quantized_comm_hazards(
+        qc_good, big, axes={"data": 8}, residual={"err": {}})
+    assert not qc_ok["hazard"] and qc_ok["quantized_reduces"] == 1, qc_ok
+    qc_nores = lint_trace.quantized_comm_hazards(
+        qc_good, big, axes={"data": 8}, residual=None)
+    assert qc_nores["hazard"] and qc_nores["findings"][0][
+        "rule"] == "quantized-comm-no-residual", qc_nores
 
     # engine 2, sequence-parallel tripwire: an activation psum on the TP
     # axis is the regression; the reduce_scatter/all_gather conjugates and

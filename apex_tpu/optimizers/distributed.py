@@ -112,13 +112,30 @@ def gather_leaf(
     *before* the collective so a bf16 gather moves half the bytes — the
     role of the reference's e5m2-compressed allgather option
     (distributed_fused_adam.py:64). The comm scope sees the CAST payload,
-    so ``monitor.comms`` tallies the gather at its true wire dtype."""
-    payload = chunk.astype(gather_dtype if gather_dtype is not None else dtype)
-    with _comm("all_gather", axis, payload):
-        full = lax.all_gather(payload, axis, axis=0, tiled=True)
+    so ``monitor.comms`` tallies the gather at its true wire dtype.
+
+    An INTEGER ``gather_dtype`` (int8) goes one notch further: the chunk
+    is quantized at a per-chunk fp32 scale (tiny side-channel gather) and
+    decoded after the collective (parallel/quantize.py) — 1 B/elem on the
+    wire; the fp32 masters stay exact and every rank decodes the same
+    view, so ranks cannot diverge."""
     n_elems = 1
     for s in shape:
         n_elems *= s
+    if gather_dtype is not None and jnp.issubdtype(
+            jnp.dtype(gather_dtype), jnp.integer):
+        if jnp.dtype(gather_dtype) != jnp.dtype(jnp.int8):
+            raise ValueError(
+                f"unsupported integer gather_dtype {gather_dtype!r}: the "
+                f"quantized wire is int8 only (parallel/quantize.py)")
+        from apex_tpu.parallel.quantize import quantized_gather_chunk
+
+        full = quantized_gather_chunk(
+            chunk.astype(jnp.float32), axis, "int8")
+        return full[:n_elems].reshape(shape).astype(dtype)
+    payload = chunk.astype(gather_dtype if gather_dtype is not None else dtype)
+    with _comm("all_gather", axis, payload):
+        full = lax.all_gather(payload, axis, axis=0, tiled=True)
     return full[:n_elems].reshape(shape).astype(dtype)
 
 
@@ -168,6 +185,13 @@ def gather_stacked_leaf(
     one ROW at a time via :func:`gather_leaf` inside the layer loop; a
     whole-stack gather in a ZeRO-3 train step is exactly the hazard
     ``lint.trace.zero3_gather_hazards`` flags."""
+    if gather_dtype is not None and jnp.issubdtype(
+            jnp.dtype(gather_dtype), jnp.integer):
+        raise ValueError(
+            "integer gather_dtype (the quantized int8 wire) is per-LEAF "
+            "only (gather_leaf routes it through parallel/quantize.py); a "
+            "bare astype here would truncate the weights — bulk stacked "
+            "gathers are host-side materialization paths and stay exact")
     L = chunk.shape[0]
     payload = chunk.astype(gather_dtype if gather_dtype is not None else dtype)
     with _comm("all_gather", axis, payload):

@@ -51,11 +51,18 @@ COMM_SCOPE_PRIMS = {"psum", "pmean", "pmax", "pmin", "all_gather",
 # themselves, plus the conjugate sequence-parallel mappings
 # (tensor_parallel/mappings.py) whose forward AND custom-VJP backward each
 # run under their own comm: scope — a composite verb built on them needs no
-# re-scoping.
+# re-scoping. The quantized wire-dtype collectives (parallel/quantize.py)
+# carry their own scopes too: each books its encoded payload AND its fp32
+# scale side-channel as separate comm: call sites, so the by-wire-dtype
+# accounting (monitor/comms.CommAccount.by_verb_dtype) stays complete.
 COMM_SCOPE_HELPERS = ("_comm", "collective_scope",
                       "scatter_to_sequence_parallel_region",
                       "gather_from_sequence_parallel_region",
-                      "reduce_scatter_to_sequence_parallel_region")
+                      "reduce_scatter_to_sequence_parallel_region",
+                      "quantized_reduce_scatter",
+                      "quantized_psum_scatter",
+                      "quantized_all_gather",
+                      "quantized_gather_chunk")
 
 # The jaxpr-level decomposition contract of sequence parallelism (read
 # statically by apex_tpu.lint.trace.sequence_parallel_hazards, like the
@@ -72,6 +79,17 @@ SEQUENCE_PARALLEL_DECOMPOSED_PRIMS = ("reduce_scatter", "all_gather")
 # (optimizers/distributed.py) — a full-size grad ``psum`` on that axis
 # means the step still all-reduces what the scatter already reduces.
 ZERO_DECOMPOSED_PRIMS = ("reduce_scatter", "all_gather")
+
+# The quantized-collective contract (apex_tpu.lint.trace.
+# quantized_comm_hazards, read statically like the sets above): in a step
+# that requests a quantized grad reduce (MixedPrecisionOptimizer
+# ``reduce_dtype``), BULK reduce traffic on the zero axis must move at a
+# 1-byte wire dtype — the encoded ``all_to_all`` pair of
+# parallel/quantize.py — with only the tiny fp32 scale side-channel wider.
+# A surviving bulk fp32 ``reduce_scatter``/``all_to_all`` payload means the
+# quantization silently regressed to the 4 B/elem wire.
+QUANTIZED_WIRE_ITEMSIZE = 1
+QUANTIZED_REDUCE_PRIMS = ("reduce_scatter", "all_to_all")
 
 #: every verb in this module must run under a ``comm:`` scope; the marker
 #: opts the file into the lint rule even if the import shape changes

@@ -1,0 +1,247 @@
+"""Quantized collectives with per-chunk scales — int8/e5m2 wire dtypes.
+
+Reference: apex's contrib DistributedFusedAdam exposes an e5m2-compressed
+allgather (distributed_fused_adam.py:64 ``e5m2_allgather``); the gradient
+side of the same idea — quantizing the reduction itself — is EQuARX's
+blockwise-quantized all-reduce (PAPERS.md). XLA gives no hook into the
+collective's internal hops, so the quantized REDUCTION is emulated at the
+jaxpr level as its one-hop decomposition:
+
+    encode rows  --all_to_all(wire dtype)-->  decode --fp32 accumulate
+
+Each rank splits its payload into one row per destination rank, computes a
+per-row (per-destination-chunk) fp32 scale, encodes the rows to the 1-byte
+wire dtype, and ships them with ``all_to_all``; the scales ride a tiny fp32
+side-channel ``all_to_all`` of their own. The receiver decodes each row at
+its sender's scale and accumulates in fp32 — so the averaging factor and
+the reduction tree stay exact, and only the wire payload is lossy. The
+``psum_scatter`` a ZeRO step would issue moves 4 B/elem; the quantized pair
+moves 1 B/elem + n fp32 scales (monitor.comms books both at their wire
+dtypes — the 1/4-bytes claim is a reported number, not a docstring).
+
+Error feedback (the reason grad quantization converges): the sender keeps
+``residual = sent - decode(encode(sent))`` and adds it to the NEXT step's
+payload before encoding, so per-destination quantization errors telescope
+instead of accumulating — the classic EF/1-bit-Adam construction. The
+residual is per-rank state in the SAME flat chunk layout the ZeRO state
+uses (this rank's send error for each destination chunk, concatenated);
+``amp.MixedPrecisionOptimizer(reduce_dtype=...)`` carries it as one more
+tree inside the sharded optimizer state so an overflow-skipped step leaves
+it bit-identical per rank (amp/frontend.py). Activations need no residual:
+their consumers see fresh values every step, so the per-shard scales alone
+bound the error (the ``quantized_all_gather``/``quantized_psum_scatter``
+pair under ``GPTConfig.activation_comm_dtype``).
+
+Stochastic rounding (int8 only): adds uniform dither in [-1/2, 1/2) ulp
+before rounding, making the per-element error zero-mean — an option on top
+of (not a substitute for) the residual.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.monitor.comms import collective_scope as _comm
+
+#: every verb in this module must run under a ``comm:`` scope (the lint
+#: comm-scope rule; the marker opts the file in even if imports change)
+LINT_COMM_SCOPE = True
+
+#: wire-dtype table: canonical name -> (jnp dtype, max representable
+#: magnitude the per-chunk scale normalizes amax to). int8 uses the
+#: symmetric [-127, 127] range; e5m2 is jnp.float8_e5m2 (5 exponent /
+#: 2 mantissa bits — the reference's compressed-allgather dtype).
+WIRE_DTYPES = {
+    "int8": (jnp.int8, 127.0),
+    "e5m2": (jnp.float8_e5m2, 57344.0),
+}
+
+
+def canon_wire_dtype(dt) -> Optional[str]:
+    """Normalize a wire-dtype spec ("int8", "e5m2", jnp.int8,
+    jnp.float8_e5m2, None) to its canonical string name."""
+    if dt is None:
+        return None
+    if isinstance(dt, str):
+        name = dt.lower()
+        if name in ("fp8", "float8_e5m2"):
+            name = "e5m2"
+    else:
+        name = {jnp.dtype(jnp.int8): "int8",
+                jnp.dtype(jnp.float8_e5m2): "e5m2"}.get(jnp.dtype(dt))
+    if name not in WIRE_DTYPES:
+        raise ValueError(
+            f"unsupported quantized-collective wire dtype {dt!r}: "
+            f"expected one of {sorted(WIRE_DTYPES)}")
+    return name
+
+
+def block_scales(rows: jax.Array, wire_dtype: str) -> jax.Array:
+    """Per-row fp32 scales: ``amax(row) / wire_max`` (1.0 for all-zero
+    rows, so encode/decode never divides by zero). ``rows`` is ``(n, k)``;
+    returns ``(n,)``."""
+    _, qmax = WIRE_DTYPES[canon_wire_dtype(wire_dtype)]
+    amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=-1)
+    return jnp.where(amax > 0, amax / qmax, jnp.ones_like(amax))
+
+
+def encode(rows: jax.Array, scales: jax.Array, wire_dtype: str,
+           key: Optional[jax.Array] = None) -> jax.Array:
+    """Encode ``(n, k)`` fp32 rows at their ``(n,)`` per-row scales into
+    the wire dtype. ``key`` arms stochastic rounding (int8 only): uniform
+    dither in [-1/2, 1/2) ulp before the round, zero-mean per element."""
+    wire = canon_wire_dtype(wire_dtype)
+    dt, qmax = WIRE_DTYPES[wire]
+    scaled = rows.astype(jnp.float32) / scales[..., None]
+    if wire == "int8":
+        if key is not None:
+            scaled = scaled + jax.random.uniform(
+                key, scaled.shape, jnp.float32, -0.5, 0.5)
+        return jnp.clip(jnp.round(scaled), -qmax, qmax).astype(dt)
+    if key is not None:
+        raise ValueError("stochastic rounding is int8-only: e5m2's ulp is "
+                         "value-dependent, the uniform dither would bias")
+    return scaled.astype(dt)
+
+
+def decode(q: jax.Array, scales: jax.Array,
+           dtype: Any = jnp.float32) -> jax.Array:
+    """Decode wire-dtype rows back at their per-row scales (fp32 math)."""
+    return (q.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# the gradient reduce-scatter (the ZeRO psum_scatter's quantized form)
+# ---------------------------------------------------------------------------
+
+
+def quantized_reduce_scatter(
+    x: jax.Array,
+    n: int,
+    axis: str,
+    wire_dtype: str,
+    *,
+    residual: Optional[jax.Array] = None,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Sum-reduce ``x`` over ``axis`` into this rank's 1-D chunk, moving
+    1 B/elem on the wire instead of the fp32 psum_scatter's 4 B.
+
+    The drop-in quantized form of ``optimizers.distributed.scatter_chunk``
+    (same flatten/pad/chunk layout, same SUM semantics — callers divide by
+    the axis size for gradient averaging). ``residual`` is this rank's
+    error-feedback state (flat, ``n * chunk`` long): it is added to the
+    payload before encoding and the new residual (payload minus its own
+    decode — computable locally, no extra wire) is returned for the caller
+    to persist. Pass ``residual=None`` for stateless use (activations,
+    censuses). ``key`` arms stochastic rounding (int8 only).
+
+    Returns ``(sum_chunk, new_residual)``; ``new_residual`` is None iff
+    ``residual`` was.
+    """
+    from apex_tpu.optimizers.distributed import _flat_padded
+
+    flat = _flat_padded(x.astype(jnp.float32), n)
+    rows = flat.reshape(n, -1)
+    if residual is not None:
+        rows = rows + residual.reshape(n, -1)
+    scales = block_scales(rows, wire_dtype)
+    q = encode(rows, scales, wire_dtype, key=key)
+    with _comm("all_to_all", axis, q):
+        q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+    with _comm("all_to_all", axis, scales):
+        s_recv = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+    # decode each received row at ITS SENDER's scale, accumulate in fp32:
+    # the reduction itself is exact — only the wire payload was lossy
+    chunk = jnp.sum(decode(q_recv, s_recv), axis=0)
+    new_residual = None
+    if residual is not None:
+        new_residual = (rows - decode(q, scales)).reshape(-1)
+    return chunk, new_residual
+
+
+# ---------------------------------------------------------------------------
+# activation conjugates (sequence-parallel scatter/gather, mappings.py)
+# ---------------------------------------------------------------------------
+
+
+def _split_blocks(x: jax.Array, n: int, dim: int) -> jax.Array:
+    """``(..., n*m, ...) -> (n, ..., m, ...)``: the per-destination block
+    axis moved to the front (dim sizes must divide — the SP divisibility
+    contract, tensor_parallel/utils.divide)."""
+    dim = dim % x.ndim
+    m = x.shape[dim] // n
+    shaped = x.reshape(x.shape[:dim] + (n, m) + x.shape[dim + 1:])
+    return jnp.moveaxis(shaped, dim, 0)
+
+
+def _merge_blocks(xb: jax.Array, dim: int) -> jax.Array:
+    """Inverse of :func:`_split_blocks`: ``(n, ..., m, ...) -> merged``."""
+    dim = dim % (xb.ndim - 1)
+    moved = jnp.moveaxis(xb, 0, dim)
+    return moved.reshape(moved.shape[:dim]
+                         + (moved.shape[dim] * moved.shape[dim + 1],)
+                         + moved.shape[dim + 2:])
+
+
+def quantized_psum_scatter(x: jax.Array, axis: str, wire_dtype: str,
+                           *, scatter_dim: int) -> jax.Array:
+    """``lax.psum_scatter(scatter_dimension=scatter_dim, tiled=True)`` at a
+    1-byte wire dtype: per-destination-block scales, all_to_all of the
+    encoded blocks + fp32 scale side-channel, decode-then-accumulate. Sum
+    semantics and output shape match the fp32 collective exactly; only the
+    wire payload is lossy (bounded by the per-block scale). Stateless —
+    activation traffic carries no residual (module docstring)."""
+    n = lax.axis_size(axis)
+    xb = _split_blocks(x.astype(jnp.float32), n, scatter_dim)  # (n, ...)
+    flat = xb.reshape(n, -1)
+    scales = block_scales(flat, wire_dtype)
+    q = encode(flat, scales, wire_dtype).reshape(xb.shape)
+    with _comm("all_to_all", axis, q):
+        q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+    with _comm("all_to_all", axis, scales):
+        s_recv = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+    dec = (q_recv.astype(jnp.float32)
+           * s_recv.reshape((n,) + (1,) * (q_recv.ndim - 1)))
+    return jnp.sum(dec, axis=0).astype(x.dtype)
+
+
+def quantized_all_gather(x: jax.Array, axis: str, wire_dtype: str,
+                         *, gather_dim: int) -> jax.Array:
+    """``lax.all_gather(axis=gather_dim, tiled=True)`` at a 1-byte wire
+    dtype: one scale per source shard (fp32 side-channel), decode after the
+    gather — each rank reassembles every shard at its sender's scale, so
+    all ranks hold the SAME decoded tensor (the replicated-downstream
+    convention the SP conjugates rely on is preserved)."""
+    n = lax.axis_size(axis)
+    xf = x.astype(jnp.float32)
+    scales = block_scales(xf.reshape(1, -1), wire_dtype)  # (1,)
+    q = encode(xf.reshape(1, -1), scales, wire_dtype).reshape(x.shape)
+    with _comm("all_gather", axis, q):
+        q_full = lax.all_gather(q, axis, axis=gather_dim, tiled=True)
+    with _comm("all_gather", axis, scales):
+        s_full = lax.all_gather(scales, axis, axis=0, tiled=True)  # (n,)
+    qb = _split_blocks(q_full, n, gather_dim)  # (n, ..., local, ...)
+    dec = (qb.astype(jnp.float32)
+           * s_full.reshape((n,) + (1,) * (qb.ndim - 1)))
+    return _merge_blocks(dec, gather_dim).astype(x.dtype)
+
+
+def quantized_gather_chunk(chunk: jax.Array, axis: str, wire_dtype: str,
+                           ) -> jax.Array:
+    """All-gather a 1-D ZeRO chunk at a 1-byte wire dtype — the int8 form
+    of ``optimizers.distributed.gather_leaf``'s payload compression (the
+    reference's e5m2 allgather, distributed_fused_adam.py:64, one notch
+    further than bf16). Per-chunk scalar scale, fp32 decode; the fp32
+    masters stay exact — every rank sees the same quantized VIEW of the
+    updated params, so ranks cannot diverge. Returns the flat fp32 gather
+    (callers reshape/cast)."""
+    return quantized_all_gather(chunk, axis, wire_dtype, gather_dim=0)

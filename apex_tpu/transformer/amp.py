@@ -89,6 +89,14 @@ def build_zero_train_step(
     ``layer_specs`` is given, otherwise uniformly over the non-zero axes.
     ``(specs, state_specs)`` come from ``mp_opt.zero_init``.
 
+    Quantized grad reduce (``mp_opt.reduce_dtype``) needs no extra wiring
+    here: ``apply_gradients`` swaps its psum_scatter for the encoded
+    all_to_all pair (parallel/quantize.py) and the error-feedback residual
+    rides :class:`apex_tpu.amp.MPOptState` — ``zero_init``'s state_specs
+    already cover it (1-D per-rank leaves behind the universal chunk
+    spec), so the same builder serves both wires. Tripwire:
+    ``lint.trace.quantized_comm_hazards``.
+
     At ``zero_level=3`` (``mp_opt.zero_level``) pass ``zero3`` (the
     :class:`apex_tpu.amp.Zero3Setup` from ``mp_opt.zero3_init``) plus
     ``model`` and the pipeline shape (``num_microbatches``, optionally

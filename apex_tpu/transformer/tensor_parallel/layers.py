@@ -96,6 +96,10 @@ class ColumnParallelLinear:
     axis: Optional[str] = AXIS_MODEL
     skip_bias_add: bool = False
     sequence_parallel: bool = False
+    #: wire dtype ("int8" | "e5m2") of the sequence-parallel conjugates'
+    #: payload — the quantized encode/decode pair of parallel/quantize.py
+    #: (per-shard fp32 scales ride a tiny side-channel). None = exact.
+    comm_dtype: Optional[str] = None
     params_dtype: Any = jnp.float32
     init_method: Callable = xavier_normal
 
@@ -105,6 +109,11 @@ class ColumnParallelLinear:
                 "sequence_parallel=True requires gather_output=False: the "
                 "sequence-parallel region contract keeps the column output "
                 "TP-sharded for the row-parallel reduce-scatter downstream")
+        if self.comm_dtype is not None and not self.sequence_parallel:
+            raise ValueError(
+                "comm_dtype only applies with sequence_parallel=True: the "
+                "plain-TP copy_to/psum path has no scatter/gather conjugate "
+                "to quantize (mappings.py table 2)")
 
     def init(self, key) -> Params:
         wkey, _ = jax.random.split(key)
@@ -127,7 +136,8 @@ class ColumnParallelLinear:
     def apply(self, params: Params, x: jax.Array):
         if self.axis is not None:
             if self.sequence_parallel:
-                x = mappings.gather_from_sequence_parallel_region(x, self.axis)
+                x = mappings.gather_from_sequence_parallel_region(
+                    x, self.axis, True, self.comm_dtype)
             else:
                 x = mappings.copy_to_tensor_model_parallel_region(x, self.axis)
         y = x @ params["kernel"].astype(x.dtype)
@@ -169,6 +179,9 @@ class RowParallelLinear:
     axis: Optional[str] = AXIS_MODEL
     skip_bias_add: bool = False
     sequence_parallel: bool = False
+    #: wire dtype of the sequence-parallel reduce-scatter (and its backward
+    #: gather) — see ColumnParallelLinear.comm_dtype. None = exact.
+    comm_dtype: Optional[str] = None
     params_dtype: Any = jnp.float32
     init_method: Callable = xavier_normal
 
@@ -178,6 +191,11 @@ class RowParallelLinear:
                 "sequence_parallel=True requires input_is_parallel=True: "
                 "the sequence-parallel region contract feeds the row GEMM "
                 "from an un-gathered column-parallel output")
+        if self.comm_dtype is not None and not self.sequence_parallel:
+            raise ValueError(
+                "comm_dtype only applies with sequence_parallel=True: the "
+                "plain-TP psum path has no scatter/gather conjugate to "
+                "quantize (mappings.py table 2)")
 
     def init(self, key) -> Params:
         wkey, _ = jax.random.split(key)
@@ -203,7 +221,7 @@ class RowParallelLinear:
         if self.axis is not None:
             if self.sequence_parallel:
                 y = mappings.reduce_scatter_to_sequence_parallel_region(
-                    y, self.axis)
+                    y, self.axis, self.comm_dtype)
             else:
                 y = mappings.reduce_from_tensor_model_parallel_region(
                     y, self.axis)
@@ -238,8 +256,18 @@ class VocabParallelEmbedding:
     embedding_dim: int
     axis: Optional[str] = AXIS_MODEL
     sequence_parallel: bool = False
+    #: wire dtype of the sequence-parallel closing reduce-scatter (and its
+    #: backward gather) — see ColumnParallelLinear.comm_dtype. None = exact.
+    comm_dtype: Optional[str] = None
     params_dtype: Any = jnp.float32
     init_method: Callable = xavier_normal
+
+    def __post_init__(self):
+        if self.comm_dtype is not None and not self.sequence_parallel:
+            raise ValueError(
+                "comm_dtype only applies with sequence_parallel=True: the "
+                "plain-TP psum path has no scatter/gather conjugate to "
+                "quantize (mappings.py table 2)")
 
     def init(self, key) -> Params:
         return {
@@ -266,7 +294,7 @@ class VocabParallelEmbedding:
         # conservative shard_map transpose and mis-scale the table gradient.
         if self.sequence_parallel:
             return mappings.reduce_scatter_to_sequence_parallel_region(
-                out, self.axis)
+                out, self.axis, self.comm_dtype)
         return mappings.reduce_from_tensor_model_parallel_region(out, self.axis)
 
 

@@ -37,6 +37,15 @@ activation between them is 1/tp the size:
 plain slice — for call sites whose downstream cotangent is already
 REPLICATED across the TP group (e.g. after an identity-forward/psum-backward
 ``copy_to``), where a reduce-scatter would over-count by the axis size.
+
+Quantized wire dtypes: each sequence-parallel conjugate takes a
+``comm_dtype`` ("int8" | "e5m2", default None = exact) routing its forward
+AND custom-VJP backward through the per-shard-scaled encode/decode pair in
+``apex_tpu.parallel.quantize`` — 1 B/elem on the wire plus a tiny fp32
+scale side-channel, with sums accumulated in fp32 after decode. Activation
+traffic carries no error-feedback residual (fresh values every step; the
+per-shard scales bound the error — quantize.py module doc). Threaded from
+``GPTConfig/BertConfig.activation_comm_dtype``.
 """
 
 from __future__ import annotations
@@ -151,31 +160,59 @@ gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
 _SEQ_DIM = 1
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def scatter_to_sequence_parallel_region(x, axis: str = AXIS_MODEL):
+def _seq_all_gather(x, axis: str, comm_dtype):
+    """The SP all-gather at its wire dtype: exact when ``comm_dtype`` is
+    None, otherwise the per-shard-scaled encode/ship/decode pair (every rank
+    decodes shard i at sender i's scale, so the gathered tensor stays
+    identical across ranks — the replicated-downstream convention holds)."""
+    if comm_dtype is not None:
+        from apex_tpu.parallel.quantize import quantized_all_gather
+
+        return quantized_all_gather(x, axis, comm_dtype, gather_dim=_SEQ_DIM)
+    with _comm("all_gather", axis, x):
+        return lax.all_gather(x, axis, axis=_SEQ_DIM, tiled=True)
+
+
+def _seq_psum_scatter(x, axis: str, comm_dtype):
+    """The SP reduce-scatter at its wire dtype: exact when ``comm_dtype``
+    is None, otherwise per-destination-block scales + encoded all_to_all
+    with the sum accumulated in fp32 after decode (quantize.py)."""
+    if comm_dtype is not None:
+        from apex_tpu.parallel.quantize import quantized_psum_scatter
+
+        return quantized_psum_scatter(x, axis, comm_dtype,
+                                      scatter_dim=_SEQ_DIM)
+    with _comm("psum_scatter", axis, x):
+        return lax.psum_scatter(x, axis, scatter_dimension=_SEQ_DIM, tiled=True)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_sequence_parallel_region(x, axis: str = AXIS_MODEL,
+                                        comm_dtype=None):
     """Slice this rank's sequence chunk forward, all-gather backward.
 
     The entry into a sequence-sharded region from a REPLICATED tensor: each
     shard consumes only its rows, so the assembled (all-gathered) cotangent
-    is the exact total gradient on every rank."""
+    is the exact total gradient on every rank. ``comm_dtype`` ("int8" |
+    "e5m2") quantizes the backward gather's wire payload (module doc)."""
     return _local_slice(x, axis, _SEQ_DIM)
 
 
-def _seq_scatter_fwd(x, axis):
+def _seq_scatter_fwd(x, axis, comm_dtype):
     return _local_slice(x, axis, _SEQ_DIM), None
 
 
-def _seq_scatter_bwd(axis, _, g):
-    with _comm("all_gather", axis, g):
-        return (lax.all_gather(g, axis, axis=_SEQ_DIM, tiled=True),)
+def _seq_scatter_bwd(axis, comm_dtype, _, g):
+    return (_seq_all_gather(g, axis, comm_dtype),)
 
 
 scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def gather_from_sequence_parallel_region(
-    x, axis: str = AXIS_MODEL, tensor_parallel_output_grad: bool = True
+    x, axis: str = AXIS_MODEL, tensor_parallel_output_grad: bool = True,
+    comm_dtype=None,
 ):
     """All-gather the sequence dim forward; backward reduce-scatters.
 
@@ -185,29 +222,27 @@ def gather_from_sequence_parallel_region(
     the adjoint both sums over ranks and re-shards the sequence — exactly
     ``psum_scatter``. Pass ``tensor_parallel_output_grad=False`` when the
     downstream cotangent is already replicated (a ``copy_to`` psum'd it);
-    the adjoint is then a plain slice."""
-    with _comm("all_gather", axis, x):
-        return lax.all_gather(x, axis, axis=_SEQ_DIM, tiled=True)
+    the adjoint is then a plain slice. ``comm_dtype`` ("int8" | "e5m2")
+    quantizes both wire payloads (module doc)."""
+    return _seq_all_gather(x, axis, comm_dtype)
 
 
-def _seq_gather_fwd(x, axis, tensor_parallel_output_grad):
-    with _comm("all_gather", axis, x):
-        return lax.all_gather(x, axis, axis=_SEQ_DIM, tiled=True), None
+def _seq_gather_fwd(x, axis, tensor_parallel_output_grad, comm_dtype):
+    return _seq_all_gather(x, axis, comm_dtype), None
 
 
-def _seq_gather_bwd(axis, tensor_parallel_output_grad, _, g):
+def _seq_gather_bwd(axis, tensor_parallel_output_grad, comm_dtype, _, g):
     if tensor_parallel_output_grad:
-        with _comm("psum_scatter", axis, g):
-            return (lax.psum_scatter(
-                g, axis, scatter_dimension=_SEQ_DIM, tiled=True),)
+        return (_seq_psum_scatter(g, axis, comm_dtype),)
     return (_local_slice(g, axis, _SEQ_DIM),)
 
 
 gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_scatter_to_sequence_parallel_region(x, axis: str = AXIS_MODEL):
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(x, axis: str = AXIS_MODEL,
+                                               comm_dtype=None):
     """psum_scatter the sequence dim forward, all-gather backward.
 
     Replaces the row-parallel forward ``psum``
@@ -218,20 +253,17 @@ def reduce_scatter_to_sequence_parallel_region(x, axis: str = AXIS_MODEL):
     that follows holds 1/tp the activation bytes. The backward all-gather
     hands every rank the assembled full-sequence cotangent — identical
     across ranks, preserving the Megatron replicated-downstream convention
-    for the producer's parameters."""
-    with _comm("psum_scatter", axis, x):
-        return lax.psum_scatter(x, axis, scatter_dimension=_SEQ_DIM, tiled=True)
+    for the producer's parameters. ``comm_dtype`` ("int8" | "e5m2")
+    quantizes both wire payloads (module doc)."""
+    return _seq_psum_scatter(x, axis, comm_dtype)
 
 
-def _seq_rs_fwd(x, axis):
-    with _comm("psum_scatter", axis, x):
-        return lax.psum_scatter(
-            x, axis, scatter_dimension=_SEQ_DIM, tiled=True), None
+def _seq_rs_fwd(x, axis, comm_dtype):
+    return _seq_psum_scatter(x, axis, comm_dtype), None
 
 
-def _seq_rs_bwd(axis, _, g):
-    with _comm("all_gather", axis, g):
-        return (lax.all_gather(g, axis, axis=_SEQ_DIM, tiled=True),)
+def _seq_rs_bwd(axis, comm_dtype, _, g):
+    return (_seq_all_gather(g, axis, comm_dtype),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_seq_rs_fwd, _seq_rs_bwd)

@@ -185,6 +185,17 @@ def _zero_env_level():
     return zero, (3 if zero_env.strip() == "3" else 2 if zero else 0)
 
 
+def _qcomm_env():
+    """Wire dtype of the ZeRO grad reduce-scatter from BENCH_QCOMM
+    ('int8'/'e5m2'; '1' -> 'int8'; unset/empty -> None = exact fp32 wire).
+    Only meaningful with BENCH_ZERO armed at level 1/2 — the builder
+    rejects other combinations, same as the library knob."""
+    v = os.environ.get("BENCH_QCOMM", "").strip().lower()
+    if not v:
+        return None
+    return "int8" if v == "1" else v
+
+
 def _is_oom(e: Exception) -> bool:
     # walk the cause chain: the ladder re-raises OOMs as RuntimeError with
     # the jaxlib RESOURCE_EXHAUSTED as __cause__
@@ -260,8 +271,20 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
     # degenerate — but the rung exercises the exact end-to-end program a
     # dp>1 pod runs, through the tunnel, with rung provenance recording
     # it. Off by default: the headline program stays byte-identical.
+    # BENCH_QCOMM=int8|e5m2 (with BENCH_ZERO at level 1/2) additionally
+    # quantizes the grad reduce-scatter wire: encoded all_to_all +
+    # per-chunk fp32 scales + error-feedback residual in the sharded
+    # state (parallel/quantize.py).
     zero, zero_level = _zero_env_level()
     zero_level = zero_level or 2
+    qcomm = _qcomm_env()
+    if qcomm and not zero:
+        # a silently-dropped knob would make a "quantized vs baseline"
+        # comparison two identical fp32 runs — fail loudly instead, same
+        # as pretrain_gpt's --reduce-dtype-requires---zero arg check
+        raise SystemExit(
+            "BENCH_QCOMM requires BENCH_ZERO (levels 1/2): the quantized "
+            "wire is the ZeRO grad reduce-scatter")
     cfg = GPTConfig(
         vocab_size=50304,
         hidden_size=hidden or int(os.environ.get("BENCH_HIDDEN", "1024")),
@@ -292,7 +315,8 @@ def build(policy_level: str, impl: str, remat_policy=None, hidden=None,
         opt, policy, log_grad_norm=bool(os.environ.get("BENCH_JOURNAL")),
         zero_axis="data" if zero else None,
         zero_level=zero_level,
-        gather_dtype="bf16" if (zero and fused) else None)
+        gather_dtype="bf16" if (zero and fused) else None,
+        reduce_dtype=qcomm if zero else None)
     params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
 
     if zero:
@@ -481,7 +505,10 @@ def prepare_resilient(level, impl, batch, seq, steps, *, min_batch=1,
                                        "scan": scan_chunk,
                                        "unroll": unroll,
                                        "zero": zero,
-                                       "zero_level": zero_level})
+                                       "zero_level": zero_level,
+                                       "reduce_dtype": (_qcomm_env() or
+                                                        "fp32") if zero
+                                       else None})
             except Exception as e:  # noqa: BLE001 - jaxlib error types vary
                 if not _is_oom(e):
                     raise

@@ -48,20 +48,26 @@ from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 # data axis (amp.MixedPrecisionOptimizer(zero_axis="data") with a bf16-
 # compressed param gather), "zero3" = fully-sharded params on top
 # (zero_level=3: the bf16 model persists as 1/dp chunk trees with
-# per-layer just-in-time weight gathers in the layer loop). Each marked
-# config records its comm/static-hazard blocks next to the plain twin so
-# the decomposed-collective structure shows up in scaling_table.json.
-GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (8, 1, 1, 1, "zero3"), (4, 2, 1),
+# per-layer just-in-time weight gathers in the layer loop), "zero-q8" =
+# the ZeRO row with the grad reduce-scatter quantized to an int8 wire
+# (reduce_dtype="int8": encoded all_to_all + per-chunk fp32 scales +
+# error-feedback residual, parallel/quantize.py — the row's
+# comm_bytes_by_verb_dtype block shows the 1/4-bytes wire next to the
+# fp32 twin). Each marked config records its comm/static-hazard blocks
+# next to the plain twin so the decomposed-collective structure shows up
+# in scaling_table.json.
+GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (8, 1, 1, 1, "zero-q8"),
+        (8, 1, 1, 1, "zero3"), (4, 2, 1),
         (4, 2, 1, 1, "sp"), (2, 1, 4), (1, 2, 4), (2, 1, 2, 2)]
 
 
 def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
                micro_batch, n_micro, steps, sequence_parallel=False,
-               zero=False, zero_level=None):
+               zero=False, zero_level=None, reduce_dtype=None):
     n_dev = dp * tp * pp * cp
     if len(jax.devices()) < n_dev:
         return None
-    zero_level = zero_level or (2 if zero else 0)
+    zero_level = zero_level or (2 if zero or reduce_dtype else 0)
     zero = zero_level > 0
     mesh = mesh_lib.make_virtual_mesh(
         n_dev, tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp,
@@ -85,7 +91,8 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             FusedAdam(lr=1e-4), policy,
             zero_axis=mesh_lib.AXIS_DATA if zero else None,
             zero_level=zero_level or 2,
-            gather_dtype="bf16" if zero else None)
+            gather_dtype="bf16" if zero else None,
+            reduce_dtype=reduce_dtype if zero else None)
         full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
         # shared TP x PP wiring (specs, placement, pipelined loss)
         specs, params, pipe_loss = prepare_pipelined_model(
@@ -185,6 +192,8 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         if zero:
             conf["zero"] = True
             conf["zero_level"] = zero_level
+        if reduce_dtype:
+            conf["reduce_dtype"] = reduce_dtype
         row = {
             "config": conf,
             "avg_iteration_time_s": round(dt, 4),
@@ -194,6 +203,10 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             # traced payload bytes per mesh axis (per traced call site —
             # scanned sites count once; see monitor/comms.py)
             "comm_bytes_by_axis": comm_acct.by_axis(),
+            # wire-dtype rollup (CommAccount.by_verb_dtype): a quantized
+            # reduce's int8 payload and its fp32 scale side-channel land
+            # as separate rows — monitor.report rolls these up per run
+            "comm_bytes_by_verb_dtype": comm_acct.by_verb_dtype(),
         }
         try:
             # MFU/roofline verdict per config (monitor/mfu.py): cost-model
@@ -398,14 +411,16 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
         cp = entry[3] if len(entry) > 3 else 1
         marks = set(entry[4:])
         sp = "sp" in marks
-        zero_level = 3 if "zero3" in marks else 2 if "zero" in marks else 0
+        reduce_dtype = "int8" if "zero-q8" in marks else None
+        zero_level = (3 if "zero3" in marks
+                      else 2 if "zero" in marks or reduce_dtype else 0)
         zero = zero_level > 0
         for layers in layers_list:
             res = run_config(
                 dp, tp, pp, cp, hidden=hidden, layers=layers, heads=heads,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
                 n_micro=n_micro, steps=steps, sequence_parallel=sp,
-                zero_level=zero_level)
+                zero_level=zero_level, reduce_dtype=reduce_dtype)
             if res is None:
                 # not enough devices — no layer count will change that;
                 # record ONE skipped row for this config and move on
@@ -428,10 +443,11 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             # key set would make a later plain config look like its
             # duplicate and silently skip it
             defaults = {"cp": 1, "sequence_parallel": False, "zero": False,
-                        "zero_level": 0}
+                        "zero_level": 0, "reduce_dtype": None}
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
                         "sequence_parallel": sp and tp > 1, "zero": zero,
-                        "zero_level": zero_level, "layers": eff}
+                        "zero_level": zero_level,
+                        "reduce_dtype": reduce_dtype, "layers": eff}
             if any({k: r["config"].get(k, defaults.get(k, 1))
                     for k in base_cfg} == base_cfg
                    for r in rows):
@@ -451,6 +467,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                 cp_tag = f"_cp{cp}" if cp > 1 else ""
                 cp_tag += "_sp" if sp and tp > 1 else ""
                 cp_tag += ("_zero3" if zero_level >= 3
+                           else "_zero_q8" if zero and reduce_dtype
                            else "_zero" if zero else "")
                 name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
                 with open(os.path.join(output_dir, name), "w") as f:
@@ -478,6 +495,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
         c = r["config"]
         sp_mark = ("sp" if c.get("sequence_parallel")
                    else "zero3" if c.get("zero_level", 0) >= 3
+                   else "zeroq8" if c.get("zero") and c.get("reduce_dtype")
                    else "zero" if c.get("zero") else "-")
         if c.get("placement_rung"):
             z3 = r["param_state_report"]["per_rank"]["zero3"]["total_bytes"]

@@ -46,6 +46,22 @@ block (``monitor.hbm.optimizer_state_report`` at the 345M flagship
 shape, via ``eval_shape`` — no buffers) carries the bytes/rank ÷ dp
 claim. Default output: ``out/zero_evidence.json``.
 
+Quantized collectives (r10): ``--qcomm`` is the quantized-grad-reduce
+evidence mode (host-side; the error-feedback microbenchmark EXECUTES on
+CPU, everything else is trace-only): the SAME dp-only O2 ZeRO train step
+is traced at the fp32 wire (``reduce_dtype=None``) and the int8 wire
+(``reduce_dtype="int8"``), and the record shows the compiled
+reduce-scatter's wire bytes dropping to exactly 1/4 — payload bytes per
+(verb, wire dtype) from ``monitor.comms.CommAccount.by_verb_dtype``
+(the int8 all_to_all row vs the fp32 psum_scatter row, with the fp32
+per-chunk scale side-channel booked separately) — plus the
+``lint.trace.quantized_comm_hazards`` census (the fp32-wire step IS the
+fat-wire hazard under a quantized-reduce request; the int8 step must
+trace clean with a residual leaf in its state). An ``error_feedback``
+block runs the repeated-step microbenchmark for real: the cumulative
+quantization error of the reduce DIVERGES without the residual and
+stays bounded with it. Default output: ``out/qcomm_evidence.json``.
+
 ZeRO-3 (r9): ``--zero3`` is the fully-sharded-param evidence mode
 (host-side trace only, no TPU): the SAME dp-only loss+grad is traced
 through the fully-sharded drive (``zero3_shard`` chunks + per-layer
@@ -448,6 +464,193 @@ def zero3_gather_census(dp, *, hidden, layers, heads, seq, vocab):
     return out, n_params
 
 
+def qcomm_evidence_census(dp, *, hidden, layers, heads, seq, vocab):
+    """The quantized-grad-reduce claim as numbers — host-side trace only.
+
+    Traces the same dp-only O2 ZeRO train step at the fp32 wire
+    (``reduce_dtype=None``) and the int8 wire (``reduce_dtype="int8"``)
+    under an axis_env binding and reports, for the data axis: payload
+    bytes per (verb, wire dtype) (``monitor.comms.CommAccount.
+    by_verb_dtype`` — the int8 all_to_all row must be exactly 1/4 of the
+    fp32 psum_scatter row, the fp32 per-chunk scale side-channel booked
+    separately) and the ``lint.trace.quantized_comm_hazards`` census (the
+    fp32-wire step is the fat-wire hazard when read as a quantized-reduce
+    request; the int8 step must trace clean, with a residual 'err' leaf
+    in its abstract state)."""
+    from apex_tpu import amp
+    from apex_tpu.lint.trace import quantized_comm_hazards
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.monitor.comms import comm_accounting
+    from apex_tpu.optimizers import FusedAdam
+
+    cfg = GPTConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
+        axis=None, compute_dtype=jnp.bfloat16, remat=False)
+    model = GPTModel(cfg)
+    policy = amp.get_policy("O2")
+    # zero-valued params at full shape: values are unused for COUNTING
+    # (zero_evidence_census idiom), nothing touches a device mesh
+    params = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype),
+        jax.eval_shape(lambda k: amp.cast_params(model.init(k), policy),
+                       jax.random.PRNGKey(0)))
+    toks = jnp.zeros((2, seq), jnp.int32)
+
+    modes = {
+        "fp32_wire": amp.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-4), policy, zero_axis="data",
+            gather_dtype="bf16"),
+        "int8_wire": amp.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-4), policy, zero_axis="data",
+            gather_dtype="bf16", reduce_dtype="int8"),
+        "e5m2_wire": amp.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-4), policy, zero_axis="data",
+            gather_dtype="bf16", reduce_dtype="e5m2"),
+    }
+    out = {}
+    for label, mp_opt in modes.items():
+        def step(p, toks, tgts, mp_opt=mp_opt):
+            s = mp_opt.init(p)
+
+            def scaled(p):
+                return model.loss(p, toks, tgts) * s.scaler.loss_scale
+
+            loss, g = jax.value_and_grad(scaled)(p)
+            new_p, _new_s, _m = mp_opt.apply_gradients(s, p, g)
+            return new_p, loss
+
+        with comm_accounting() as acct:
+            jx = jax.make_jaxpr(step, axis_env=[("data", dp)])(
+                params, toks, toks)
+        if mp_opt.reduce_dtype is not None:
+            # the abstract state (host-side, no axis binding needed —
+            # only the axis SIZE enters the chunk shapes) carries the
+            # residual tree the hazard check wants to see
+            import types
+
+            residual = mp_opt.zero_abstract_state(
+                params, types.SimpleNamespace(shape={"data": dp})).residual
+        else:
+            residual = "unchecked"
+        hz = quantized_comm_hazards(jx, zero_axis="data", residual=residual)
+        out[label] = {
+            "comm_bytes_by_verb_dtype": acct.by_verb_dtype(axis="data"),
+            "hazard": hz["hazard"],
+            "fat_reduces": hz["fat_reduces"],
+            "quantized_reduces": hz["quantized_reduces"],
+            "census": hz["census"],
+            "residual_in_state": (mp_opt.reduce_dtype is not None
+                                  and isinstance(residual, dict)
+                                  and "err" in residual),
+        }
+    return out
+
+
+def error_feedback_microbench(dp=8, elems=4099, steps=24, seed=0):
+    """The repeated-step error-feedback claim, EXECUTED (CPU, vmap binds
+    the axis): reduce the SAME per-rank gradients ``steps`` times through
+    the int8 wire and track ``|cumulative_decoded - t * exact|``. Without
+    the residual the per-step rounding bias is constant-signed and the
+    cumulative error grows ~linearly; with error feedback each step's
+    payload carries the previous step's error, so the partial sums
+    telescope and the error stays bounded by one quantization step."""
+    from apex_tpu.optimizers.distributed import scatter_chunk
+    from apex_tpu.parallel.quantize import quantized_reduce_scatter
+
+    grads = jax.random.normal(jax.random.PRNGKey(seed), (dp, elems),
+                              jnp.float32)
+    exact = jax.vmap(lambda g: scatter_chunk(g, dp, "data"),
+                     axis_name="data")(grads)
+    pad = (elems + dp - 1) // dp * dp
+
+    def run(with_ef):
+        residual = jnp.zeros((dp, pad), jnp.float32)
+        cum = jnp.zeros_like(exact)
+        curve = []
+        for t in range(1, steps + 1):
+            def one(g, r):
+                c, nr = quantized_reduce_scatter(
+                    g, dp, "data", "int8",
+                    residual=(r if with_ef else None))
+                return c, (nr if nr is not None else r)
+            chunk, residual = jax.vmap(one, axis_name="data")(grads, residual)
+            cum = cum + chunk
+            curve.append(round(float(jnp.max(jnp.abs(cum - t * exact))), 6))
+        return curve
+
+    ef, no_ef = run(True), run(False)
+    return {
+        "steps": steps, "elems": elems, "dp": dp,
+        "max_abs_error_with_ef": ef,
+        "max_abs_error_without_ef": no_ef,
+        # bounded: the EF curve's tail is no worse than its early window
+        # (x2 slack for the dither of which chunk the error lands in);
+        # diverging: the unassisted curve keeps growing past the EF bound
+        "ef_bounded": ef[-1] <= 2.0 * max(ef[:4]),
+        "no_ef_diverges": no_ef[-1] > 3.0 * ef[-1],
+    }
+
+
+def _qcomm_main(args) -> int:
+    """``--qcomm``: the quantized-collectives evidence record
+    (out/qcomm_evidence.json)."""
+    record = {"metric": "quantized_collectives_evidence", "dp": args.dp,
+              "hidden": args.hidden, "layers": args.layers,
+              "seq": args.seq, "vocab": args.vocab}
+    ok_census = ok_bytes = ok_ef = False
+    try:
+        census = qcomm_evidence_census(
+            args.dp, hidden=args.hidden, layers=args.layers,
+            heads=args.heads, seq=args.seq, vocab=args.vocab)
+        record["collective_census"] = census
+        fp32 = census["fp32_wire"]["comm_bytes_by_verb_dtype"]
+        int8 = census["int8_wire"]["comm_bytes_by_verb_dtype"]
+        e5m2 = census["e5m2_wire"]["comm_bytes_by_verb_dtype"]
+        fp32_scatter = fp32.get("psum_scatter[float32]", {}).get("bytes", 0)
+        int8_payload = int8.get("all_to_all[int8]", {}).get("bytes", 0)
+        e5m2_payload = e5m2.get("all_to_all[float8_e5m2]", {}).get("bytes", 0)
+        int8_scales = int8.get("all_to_all[float32]", {}).get("bytes", 0)
+        record["wire_compression"] = {
+            "fp32_scatter_bytes": fp32_scatter,
+            "int8_payload_bytes": int8_payload,
+            "e5m2_payload_bytes": e5m2_payload,
+            "scale_sidechannel_bytes": int8_scales,
+            "ratio_int8": round(fp32_scatter / max(int8_payload, 1), 3),
+            "ratio_e5m2": round(fp32_scatter / max(e5m2_payload, 1), 3),
+        }
+        # the compiled reduce moves EXACTLY 1/4 the fp32 bytes at both
+        # 1-byte wires; the scale side-channel is booked but tiny
+        ok_bytes = (int8_payload > 0
+                    and int8_payload * 4 == fp32_scatter
+                    and e5m2_payload * 4 == fp32_scatter
+                    and 0 < int8_scales < int8_payload // 16)
+        # the fp32-wire step IS the fat-wire hazard under a quantized-
+        # reduce reading; both quantized steps trace clean with residuals
+        ok_census = (census["fp32_wire"]["fat_reduces"] > 0
+                     and not census["int8_wire"]["hazard"]
+                     and census["int8_wire"]["quantized_reduces"] > 0
+                     and census["int8_wire"]["residual_in_state"]
+                     and not census["e5m2_wire"]["hazard"])
+    except Exception as e:  # noqa: BLE001 - a negative result is a result
+        record["census_error"] = str(e)[:400]
+    try:
+        ef = error_feedback_microbench(dp=args.dp)
+        record["error_feedback"] = ef
+        ok_ef = bool(ef["ef_bounded"] and ef["no_ef_diverges"])
+    except Exception as e:  # noqa: BLE001
+        record["error_feedback"] = {"error": str(e)[:300]}
+    record["checks"] = {"census": ok_census, "wire_bytes": ok_bytes,
+                        "error_feedback": ok_ef}
+    record["ok"] = bool(ok_census and ok_bytes and ok_ef)
+    print(json.dumps(record))
+    output = args.output or os.path.join("out", "qcomm_evidence.json")
+    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+    with open(output, "w") as f:
+        json.dump(record, f, indent=1)
+    return 0 if record["ok"] else 1
+
+
 def _zero3_main(args) -> int:
     """``--zero3``: the fully-sharded-param evidence record
     (out/zero3_evidence.json)."""
@@ -622,11 +825,20 @@ def main():
                          "control, gather-byte conservation, the 345M "
                          "param_state_report table, and the 2.7B-class "
                          "placement rung; writes out/zero3_evidence.json")
+    ap.add_argument("--qcomm", action="store_true",
+                    help="quantized-collectives evidence mode (host-side, "
+                         "no TPU): fp32-wire vs int8/e5m2-wire ZeRO step "
+                         "traces — bytes per (verb, wire dtype), the "
+                         "quantized_comm_hazards census, and the executed "
+                         "error-feedback microbenchmark; writes "
+                         "out/qcomm_evidence.json")
     ap.add_argument("--dp", type=int, default=8,
                     help="data-axis size for the --zero census/state table")
     ap.add_argument("--output", default=None)
     args = ap.parse_args()
 
+    if args.qcomm:
+        sys.exit(_qcomm_main(args))
     if args.zero3:
         sys.exit(_zero3_main(args))
     if args.zero:

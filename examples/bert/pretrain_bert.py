@@ -48,11 +48,20 @@ def parse_args():
                    help="ZeRO stage (implies --zero). 3 shards the bf16 "
                         "params too: 1/dp chunk trees with per-layer "
                         "just-in-time weight gathers in the layer loop")
+    p.add_argument("--reduce-dtype", default=None, choices=["int8", "e5m2"],
+                   help="quantize the ZeRO grad reduce-scatter wire "
+                        "(requires --zero, levels 1/2): encoded all_to_all "
+                        "at 1 B/elem + per-chunk fp32 scales, with an "
+                        "error-feedback residual in the sharded state "
+                        "(parallel/quantize.py)")
     args = p.parse_args()
     if args.zero_level is not None:
         args.zero = True
     elif args.zero:
         args.zero_level = 2
+    if args.reduce_dtype and not args.zero:
+        p.error("--reduce-dtype requires --zero (it is the ZeRO grad "
+                "reduce-scatter wire dtype)")
     return args
 
 
@@ -102,7 +111,8 @@ def main():
             # in half precision (cast O2/O3); for fp32-param policies
             # (O0/O1) it would round the weights every step.
             gather_dtype="bf16" if policy.cast_model_type is not None
-            else None)
+            else None,
+            reduce_dtype=args.reduce_dtype)
         params = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
         pspecs = jax.tree.map(lambda _: P(), params)
         data_spec = P("data")

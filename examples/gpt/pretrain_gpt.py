@@ -78,9 +78,18 @@ def parse_args():
                         "all-gathered just-in-time inside the layer loop "
                         "and grads reduce-scatter per layer (no bulk "
                         "post-update gather)")
-    p.add_argument("--zero-gather", default=None, choices=["bf16"],
+    p.add_argument("--zero-gather", default=None, choices=["bf16", "int8"],
                    help="compress the ZeRO param all-gather payload "
-                        "(halves gather bytes; fp32 masters stay exact)")
+                        "(bf16 halves gather bytes; int8 quantizes to "
+                        "1 B/elem at a per-chunk fp32 scale — "
+                        "parallel/quantize.py; fp32 masters stay exact)")
+    p.add_argument("--reduce-dtype", default=None, choices=["int8", "e5m2"],
+                   help="quantize the ZeRO grad reduce-scatter wire "
+                        "(requires --zero, levels 1/2): the fp32 "
+                        "psum_scatter becomes the encoded all_to_all pair "
+                        "at 1 B/elem + per-chunk fp32 scales, with an "
+                        "error-feedback residual in the sharded optimizer "
+                        "state (parallel/quantize.py)")
     p.add_argument("--data", default=None, help="dir of .bin int32 token files")
     p.add_argument("--save-dir", default=None)
     p.add_argument("--save-every", type=int, default=100)
@@ -96,6 +105,9 @@ def parse_args():
         args.zero_level = 2
     if args.zero_gather and not args.zero:
         p.error("--zero-gather requires --zero")
+    if args.reduce_dtype and not args.zero:
+        p.error("--reduce-dtype requires --zero (it is the ZeRO grad "
+                "reduce-scatter wire dtype)")
     return args
 
 
@@ -134,7 +146,8 @@ def main():
         log_group_norms=bool(args.journal),
         zero_axis=mesh_lib.AXIS_DATA if args.zero else None,
         zero_level=args.zero_level or 2,
-        gather_dtype=args.zero_gather)
+        gather_dtype=args.zero_gather,
+        reduce_dtype=args.reduce_dtype)
 
     full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
     all_specs = model.specs()
@@ -255,7 +268,8 @@ def main():
             meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
                   "dp": dp, "hidden": args.hidden, "layers": args.layers,
                   "seq": args.seq, "batch": batch, "zero": bool(args.zero),
-                  "zero_level": args.zero_level or 0})
+                  "zero_level": args.zero_level or 0,
+                  "reduce_dtype": args.reduce_dtype or "fp32"})
         try:
             # per-rank residency footprints (monitor/hbm.py): the ZeRO
             # bytes/rank ÷ dp claim — and under --zero-level 3 the

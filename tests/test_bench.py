@@ -21,6 +21,21 @@ def test_stats_median_min_max():
     assert s["median"] == 2.5
 
 
+def test_qcomm_env_value_mapping(monkeypatch):
+    """BENCH_QCOMM: '1' aliases int8, explicit dtypes pass through,
+    unset/empty means the exact fp32 wire."""
+    monkeypatch.delenv("BENCH_QCOMM", raising=False)
+    assert bench._qcomm_env() is None
+    monkeypatch.setenv("BENCH_QCOMM", "")
+    assert bench._qcomm_env() is None
+    monkeypatch.setenv("BENCH_QCOMM", "1")
+    assert bench._qcomm_env() == "int8"
+    monkeypatch.setenv("BENCH_QCOMM", "e5m2")
+    assert bench._qcomm_env() == "e5m2"
+    monkeypatch.setenv("BENCH_QCOMM", "INT8")
+    assert bench._qcomm_env() == "int8"
+
+
 def test_is_oom_walks_cause_chain():
     assert bench._is_oom(RuntimeError("RESOURCE_EXHAUSTED: TPU oom"))
     # the ladder re-raises with the allocator message embedded

@@ -689,6 +689,98 @@ def test_zero3_gather_on_real_gpt_step():
 
 
 # ---------------------------------------------------------------------------
+# engine 2: quantized-collective tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_comm_flags_fat_wire():
+    """A step that requests a quantized grad reduce but still moves an
+    fp32-sized bulk reduce payload on the zero axis is the fat-wire
+    regression (the itemsize-keyed census catches the surviving
+    psum_scatter AND an unencoded bulk all_to_all)."""
+    from apex_tpu.optimizers.distributed import scatter_chunk
+
+    big = jnp.ones((64, 128), jnp.float32)
+    hz = trace.quantized_comm_hazards(
+        lambda g: scatter_chunk(g, 8, "data") / 8, big, axes={"data": 8})
+    assert hz["hazard"] and hz["fat_reduces"] == 1, hz
+    assert hz["findings"][0]["rule"] == "quantized-comm-fat-wire"
+    assert hz["census"] == {"4": {"reduce_scatter": 1}}
+
+    # a bf16 wire is still fat (2 B/elem): only the 1-byte dtypes pass
+    hz2 = trace.quantized_comm_hazards(
+        lambda g: scatter_chunk(g.astype(jnp.bfloat16), 8, "data"),
+        big, axes={"data": 8})
+    assert hz2["hazard"] and hz2["census"] == {"2": {"reduce_scatter": 1}}
+
+
+def test_quantized_comm_passes_encoded_pair_and_checks_residual():
+    """The encoded all_to_all pair traces clean (the fp32 scale
+    side-channel sits below the bulk floor); a quantized GRAD reduce whose
+    state lacks the 'err' residual tree flags the error-feedback check."""
+    from apex_tpu.parallel.quantize import quantized_reduce_scatter
+
+    big = jnp.ones((64, 128), jnp.float32)
+
+    def good(g):
+        chunk, _ = quantized_reduce_scatter(g, 8, "data", "int8")
+        return chunk / 8
+
+    hz = trace.quantized_comm_hazards(good, big, axes={"data": 8},
+                                      residual={"err": {"w": None}})
+    assert not hz["hazard"], hz
+    assert hz["quantized_reduces"] == 1 and hz["census"] == {
+        "1": {"all_to_all": 1}}
+
+    hz_nores = trace.quantized_comm_hazards(good, big, axes={"data": 8},
+                                            residual=None)
+    assert hz_nores["hazard"]
+    assert hz_nores["findings"][0]["rule"] == "quantized-comm-no-residual"
+    # default: residual unchecked (activation-only traffic has none)
+    assert not trace.quantized_comm_hazards(
+        good, big, axes={"data": 8})["hazard"]
+
+
+def test_quantized_comm_on_real_mixed_precision_step():
+    """The actual reduce_dtype='int8' amp step traces clean with its
+    residual state; the SAME step read at reduce_dtype=None is the
+    flagged fat-wire pattern — the tripwire pair the selftest runs."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+
+    policy = amp.get_policy("O2")
+    params = {"w": jnp.ones((64, 64), jnp.bfloat16)}
+    grads = {"w": jnp.ones((64, 64), jnp.float32)}
+
+    q = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis="data", reduce_dtype="int8")
+
+    def q_step(p, g):
+        st = q.init(p)
+        return q.apply_gradients(st, p, g)[0]
+
+    import types
+
+    residual = q.zero_abstract_state(
+        params, types.SimpleNamespace(shape={"data": 8})).residual
+    hz = trace.quantized_comm_hazards(q_step, params, grads,
+                                      axes={"data": 8}, residual=residual)
+    assert not hz["hazard"], hz
+    assert hz["quantized_reduces"] >= 1
+
+    z = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis="data")
+
+    def fp32_step(p, g):
+        st = z.init(p)
+        return z.apply_gradients(st, p, g)[0]
+
+    hz = trace.quantized_comm_hazards(fp32_step, params, grads,
+                                      axes={"data": 8})
+    assert hz["hazard"] and hz["fat_reduces"] >= 1
+
+
+# ---------------------------------------------------------------------------
 # engine 2: recompile-hazard scanner
 # ---------------------------------------------------------------------------
 

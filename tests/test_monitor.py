@@ -338,6 +338,110 @@ def test_comm_per_layer_gather_bytes_match_bulk_gather():
     assert acct_fp32.by_axis()["data"]["bytes"] == L * k * 4
 
 
+def test_comm_accounting_books_quantized_wire_dtypes():
+    """The quantized-collective accounting contract (mirror of the
+    bf16-gather half-bytes test above, one notch further): an int8 reduce
+    books exactly 1/4 the fp32 psum_scatter bytes, e5m2 the same 1/4, and
+    the fp32 per-chunk scale side-channel lands as its OWN
+    (verb, dtype) row — so the compression ratio and the side-channel's
+    cost both read straight off ``CommAccount.by_verb_dtype``."""
+    from apex_tpu.optimizers.distributed import scatter_chunk
+    from apex_tpu.parallel.quantize import quantized_reduce_scatter
+
+    n, elems = 8, 64 * 128  # divides n: padded == logical
+    g = jnp.ones((64, 128), jnp.float32)
+
+    with comm_accounting() as acct_fp32:
+        jax.make_jaxpr(lambda x: scatter_chunk(x, n, "data"),
+                       axis_env=[("data", n)])(g)
+    fp32_bytes = acct_fp32.by_verb_dtype()["psum_scatter[float32]"]["bytes"]
+    assert fp32_bytes == elems * 4
+
+    for wire, dtype_label in (("int8", "int8"), ("e5m2", "float8_e5m2")):
+        with comm_accounting() as acct:
+            jax.make_jaxpr(
+                lambda x: quantized_reduce_scatter(x, n, "data", wire)[0],
+                axis_env=[("data", n)])(g)
+        table = acct.by_verb_dtype()
+        payload = table[f"all_to_all[{dtype_label}]"]
+        scales = table["all_to_all[float32]"]
+        assert payload["bytes"] * 4 == fp32_bytes, (wire, table)
+        assert payload["calls"] == scales["calls"] == 1
+        # side-channel: one fp32 scale per destination chunk
+        assert scales["bytes"] == n * 4, (wire, table)
+    # summary() carries the rollup for journal/report consumers
+    assert "by_verb_dtype" in acct.summary()
+
+
+def test_report_rolls_up_comm_bytes_by_verb_dtype():
+    """report.analyze aggregates comm_bytes_by_verb_dtype tables across
+    records (the scaling-harness zero-q8 rows), keeping payload and scale
+    side-channel rows distinct."""
+    from apex_tpu.monitor import report
+
+    rows = [
+        {"kind": "step", "step": 0, "wall_s": 0.1, "loss": 2.0,
+         "tokens": 100, "tokens_per_sec": 1000.0, "overflows": 0,
+         "comm_bytes_by_verb_dtype": {
+             "all_to_all[int8]": {"bytes": 1000, "calls": 2},
+             "all_to_all[float32]": {"bytes": 32, "calls": 2}}},
+        {"kind": "step", "step": 1, "wall_s": 0.1, "loss": 1.9,
+         "tokens": 100, "tokens_per_sec": 1000.0, "overflows": 0,
+         "comm_bytes_by_verb_dtype": {
+             "all_to_all[int8]": {"bytes": 1000, "calls": 2}}},
+    ]
+    analysis = report.analyze(rows)
+    table = analysis["comm_bytes_by_verb_dtype"]
+    assert table["all_to_all[int8]"] == {"bytes": 2000, "calls": 4}
+    assert table["all_to_all[float32]"] == {"bytes": 32, "calls": 2}
+
+
+def test_report_compare_loss_threshold_gate():
+    """The convergence machine check: --loss-threshold arms a final-loss
+    comparison denominated in the baseline's loss drop; off by default."""
+    from apex_tpu.monitor import report
+
+    def run(first, last, n=8):
+        losses = [first + (last - first) * i / (n - 1) for i in range(n)]
+        return [{"kind": "step", "step": i, "wall_s": 0.1, "loss": l,
+                 "tokens": 100, "tokens_per_sec": 1000.0, "overflows": 0}
+                for i, l in enumerate(losses)]
+
+    base = run(2.0, 1.0)          # drop = 1.0
+    good = run(2.0, 1.05)         # gives back 5% of the drop
+    bad = run(2.0, 1.5)           # gives back 50%
+
+    # default: no loss check at all (timing gates tolerate loss noise)
+    res = report.compare(base, bad)
+    assert not any(c["check"] == "loss_last" for c in res["checks"])
+    # armed: the 10%-of-drop gate passes the close run, fails the far one
+    assert report.compare(base, good, loss_threshold=0.1)["ok"]
+    res_bad = report.compare(base, bad, loss_threshold=0.1)
+    assert not res_bad["ok"] and "loss_last" in res_bad["regressed"]
+    # CLI spelling (the driver-facing gate)
+    import contextlib
+    import io
+    import json as _json
+    import os
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="apex_tpu_qgate_")
+    try:
+        pa, pb = os.path.join(d, "a.jsonl"), os.path.join(d, "b.jsonl")
+        for path, rows in ((pa, base), (pb, bad)):
+            with open(path, "w") as f:
+                for r in rows:
+                    f.write(_json.dumps(dict(r, ts=0.0, v=1)) + "\n")
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert report.main(["compare", pa, pb]) == 0
+            assert report.main(["compare", pa, pb,
+                                "--loss-threshold", "0.1"]) == 1
+    finally:
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def test_sequence_parallel_activation_report():
     """The tp-x memory claim as a number: per-layer sequence-region bytes
     shrink by exactly tp (both sides use the same lane-padded shape

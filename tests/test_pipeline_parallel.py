@@ -336,9 +336,15 @@ def test_sharded_head_flops_match_serial():
         toks = jax.random.randint(jax.random.PRNGKey(1), (32, 16), 0, 2048)
         tgt = jnp.roll(toks, -1, axis=-1)
 
-        serial_flops = (
+        def compiled_flops(compiled):
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+                ca = ca[0]
+            return ca["flops"]
+
+        serial_flops = compiled_flops(
             jax.jit(jax.value_and_grad(serial.loss))
-            .lower(params, toks, tgt).compile().cost_analysis()["flops"]
+            .lower(params, toks, tgt).compile()
         )
 
         specs = par.specs()
@@ -362,8 +368,8 @@ def test_sharded_head_flops_match_serial():
                 out_specs=(P(), (rest_specs, layer_specs)),
                 check_vma=False,
             ))
-            return fn.lower(rest, params["layers"], toks, tgt).compile(
-            ).cost_analysis()["flops"]
+            return compiled_flops(
+                fn.lower(rest, params["layers"], toks, tgt).compile())
 
         # cost_analysis reports the per-device SPMD program; x S for totals
         sharded_total = per_device_flops(True) * S
