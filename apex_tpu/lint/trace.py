@@ -36,6 +36,12 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   params must stay 1/n chunks gathered just-in-time per layer
   (models/_transformer.run_layers ``chunk_meta``); a whole-stack or
   post-update bulk gather silently returns peak HBM to O(model).
+- ``untimed-schedule``  (:func:`untimed_schedule_hazards`) -- a pipeline
+  schedule drive that ran while a span tracer was armed but emitted no
+  pipe spans (``monitor/tracing.py``): the step-anatomy layer exists so
+  bubble fraction and slot timings are MEASURED, and a harness that
+  drives the compiled ring under an armed tracer without the traced
+  tick drive silently regresses the timeline back to census-only.
 - ``quantized-comm``    (:func:`quantized_comm_hazards`) -- a step that
   requests a quantized grad reduce (``MixedPrecisionOptimizer
   reduce_dtype``) but whose jaxpr still moves a >= 2-byte bulk reduce
@@ -711,6 +717,54 @@ def quantized_comm_hazards(fn, *args,
 # ---------------------------------------------------------------------------
 # recompile-hazard scanner
 # ---------------------------------------------------------------------------
+
+
+def untimed_schedule_hazards(fn, *args, tracer=None,
+                             **kwargs) -> Dict[str, Any]:
+    """Flag a pipeline schedule drive whose slots emit no trace spans
+    while tracing is armed — the census-only regression.
+
+    Runs ``fn(*args, **kwargs)`` with an in-memory ``monitor.tracing``
+    tracer installed as the global, then joins two observables: the
+    schedule-drive counter
+    (``transformer.pipeline_parallel.schedules.ring_drive_count``, which
+    every ring trace AND every traced tick drive advances) against the
+    pipe-cat spans the tracer collected. A drive with no spans is the
+    hazard; a span-emitting drive (``schedules.traced_pipeline_timeline``)
+    passes; a fn with no pipeline drive at all trivially passes.
+
+    Hand ``fn`` a FRESH step callable: a jit-cached step that does not
+    re-trace cannot advance the drive counter (documented analyzer
+    limitation — presence detection, like the other tripwires).
+    """
+    from apex_tpu.monitor import tracing as tracing_mod
+    from apex_tpu.transformer.pipeline_parallel import schedules
+
+    tr = tracer if tracer is not None else tracing_mod.Tracer(None)
+    # the analyzer reads tr.records: a caller-supplied file-backed tracer
+    # (keep=False) would otherwise turn every span-emitting drive into a
+    # false-positive hazard
+    tr.keep = True
+    before = schedules.ring_drive_count()
+    with tracing_mod.scoped(tr):
+        fn(*args, **kwargs)
+    drives = schedules.ring_drive_count() - before
+    pipe_spans = [r for r in tr.records
+                  if r.get("cat") in ("pipe", "pipe-comm")]
+    hazard = drives > 0 and not pipe_spans
+    findings: List[Dict[str, Any]] = []
+    if hazard:
+        findings.append({
+            "rule": "untimed-schedule",
+            "message": (
+                f"{drives} pipeline schedule drive(s) traced under an "
+                "armed tracer with NO pipe spans emitted — the timeline "
+                "regressed to census-only; drive pipelined steps through "
+                "schedules.traced_pipeline_timeline when tracing is "
+                "armed (monitor/tracing.py)"),
+        })
+    return {"hazard": hazard, "drives": drives,
+            "pipe_spans": len(pipe_spans), "findings": findings}
 
 
 def recompile_hazards(*args, **kwargs) -> List[Dict[str, Any]]:

@@ -33,6 +33,12 @@ extracts those patterns into a reusable subsystem any training loop
   is attributable from the journal alone) and :class:`RecompileTracker`
   (jit cache misses + compile seconds per argument-shape signature —
   the shape-churn detector).
+- :mod:`tracing` — :class:`Tracer`: nested named host-side spans
+  (per-rank, crash-tolerant JSON-lines mirroring the journal) plus the
+  timeline analyzers: measured pipeline bubble fraction vs the analytic
+  :func:`tracing.expected_bubble_fraction` floor, comm/compute
+  :func:`tracing.step_anatomy` (fractions sum to 1.0 per window), and
+  Chrome trace-event export for ``chrome://tracing`` / Perfetto.
 - :mod:`report` — ``python -m apex_tpu.monitor.report <run.jsonl>``:
   throughput percentiles, stall gaps, loss spikes, HBM-growth trend,
   per-rank straggler skew, comm rollups; ``... report compare A B``
@@ -66,6 +72,14 @@ from apex_tpu.monitor.journal import (  # noqa: F401
     JournalRecords,
     MetricsJournal,
     scaler_state,
+)
+from apex_tpu.monitor.tracing import (  # noqa: F401
+    Tracer,
+    chrome_trace,
+    expected_bubble_fraction,
+    pipeline_anatomy,
+    step_anatomy,
+    timeline_summary,
 )
 from apex_tpu.monitor.mfu import (  # noqa: F401
     compiled_step_costs,

@@ -140,6 +140,8 @@ class MetricsJournal:
         self._step_costs: Optional[Dict[str, Any]] = None
         self._opt_state_bytes: Optional[int] = None
         self._param_bytes: Optional[int] = None
+        self._step_comm: Optional[Dict[str, Any]] = None
+        self._bubble: Optional[Dict[str, Any]] = None
         if meta:
             self.log(dict(meta, kind="meta"))
 
@@ -167,6 +169,36 @@ class MetricsJournal:
         }
         if method:
             self._step_costs["method"] = method
+
+    # -- step-anatomy arming (monitor/tracing.py) ---------------------------
+    def set_step_comm(self, comm_bytes_per_step: float,
+                      *, platform: Optional[str] = None) -> None:
+        """Arm per-record step-anatomy fields: once set, every
+        :meth:`step_end` record with a wall time also carries
+        ``compute_frac``/``comm_frac``/``stall_frac`` (summing to 1.0)
+        and ``overlap_fraction``, joined by ``monitor.tracing.
+        step_anatomy`` from this per-step collective payload total
+        (``monitor.comms`` accounting of the step trace), the armed
+        step costs (:meth:`set_step_costs`) and the ICI bandwidth table
+        (``APEX_TPU_PEAK_ICI_GBPS``-calibratable). Host-side only."""
+        from apex_tpu.monitor import tracing as _tracing  # lazy: stay light
+
+        self._step_comm = {"bytes": float(comm_bytes_per_step),
+                           "ici": _tracing.ici_spec(platform)}
+
+    def set_bubble_fraction(self, measured: float,
+                            expected: Optional[float] = None) -> None:
+        """Arm a per-record ``bubble_fraction`` stamp: the measured
+        per-rank pipeline bubble fraction (``schedules.
+        traced_pipeline_timeline``'s anatomy) plus the analytic
+        ``bubble_fraction_expected`` floor (``monitor.tracing.
+        expected_bubble_fraction``), so journals from pipelined runs
+        carry the schedule-quality claim ``report compare
+        --bubble-threshold`` gates on."""
+        self._bubble = {"bubble_fraction": round(float(measured), 4)}
+        if expected is not None:
+            self._bubble["bubble_fraction_expected"] = round(
+                float(expected), 4)
 
     # -- optimizer-state arming (monitor/hbm.py) ----------------------------
     def set_opt_state_bytes(self, nbytes: int) -> None:
@@ -291,6 +323,28 @@ class MetricsJournal:
                 self.overflows += 1
         if scaler is not None:
             rec.update(scaler_state(scaler))
+        if self._step_comm is not None and wall_s:
+            try:
+                from apex_tpu.monitor import tracing as _tracing
+
+                flops = None
+                spec = None
+                if self._step_costs is not None and tokens:
+                    flops = self._step_costs["flops_per_token"] * tokens
+                    spec = self._step_costs["spec"]
+                an = _tracing.step_anatomy(
+                    wall_s=wall_s, flops=flops, spec=spec,
+                    comm_bytes=self._step_comm["bytes"],
+                    ici=self._step_comm["ici"])
+                for k in ("compute_s", "comm_s", "host_stall_s",
+                          "compute_frac", "comm_frac", "stall_frac",
+                          "overlap_fraction"):
+                    if k in an:
+                        rec[k] = an[k]
+            except Exception:  # noqa: BLE001 - telemetry must not raise
+                pass
+        if self._bubble is not None:
+            rec.update(self._bubble)
         if self._opt_state_bytes is not None:
             rec["opt_state_bytes"] = self._opt_state_bytes
         if self._param_bytes is not None:

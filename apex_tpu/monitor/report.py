@@ -249,6 +249,32 @@ def analyze(
         if bw:
             out["mfu"]["hbm_bw_util_p50"] = _dist(bw).get("p50")
 
+    # timeline rollup (records from --trace-armed runs: bubble-fraction
+    # stamps from the traced pipeline drive, anatomy fractions and
+    # overlap from set_step_comm's step_anatomy join)
+    tl: Dict[str, Any] = {}
+    bub = [r["bubble_fraction"] for r in steps
+           if isinstance(r.get("bubble_fraction"), (int, float))]
+    if bub:
+        tl["bubble_fraction"] = {"last": round(bub[-1], 4),
+                                 "p50": _dist(bub).get("p50")}
+        exp = next((r["bubble_fraction_expected"] for r in steps
+                    if isinstance(r.get("bubble_fraction_expected"),
+                                  (int, float))), None)
+        if exp is not None:
+            tl["bubble_fraction_expected"] = exp
+    ovl = [r["overlap_fraction"] for r in steps
+           if isinstance(r.get("overlap_fraction"), (int, float))]
+    if ovl:
+        tl["overlap_fraction"] = _dist(ovl)
+    for key in ("compute_frac", "comm_frac", "stall_frac"):
+        vals = [r[key] for r in steps
+                if isinstance(r.get(key), (int, float))]
+        if vals:
+            tl[f"{key}_mean"] = round(sum(vals) / len(vals), 4)
+    if tl:
+        out["timeline"] = tl
+
     # optimizer-state footprint (journals armed via set_opt_state_bytes —
     # the per-rank ZeRO claim: bytes/rank ÷ dp vs a replicated run)
     osb = [r["opt_state_bytes"] for r in steps
@@ -348,6 +374,23 @@ def render(analysis: Dict[str, Any], file=None) -> None:
         for key, row in sorted(comm_dt.items()):
             p(f"comm {key}: {row['bytes'] / 1e6:.2f} MB over "
               f"{row['calls']} call site(s)")
+    tl = analysis.get("timeline")
+    if tl:
+        bf = tl.get("bubble_fraction") or {}
+        parts = []
+        if bf:
+            exp = tl.get("bubble_fraction_expected")
+            parts.append(f"bubble p50 {bf.get('p50')}"
+                         + (f" (analytic floor {exp})"
+                            if exp is not None else ""))
+        if tl.get("overlap_fraction"):
+            parts.append(f"overlap p50 {tl['overlap_fraction'].get('p50')}")
+        fr = [f"{k[:-10]} {tl[k]}" for k in
+              ("compute_frac_mean", "comm_frac_mean", "stall_frac_mean")
+              if k in tl]
+        if fr:
+            parts.append("anatomy " + "/".join(fr))
+        p("timeline: " + "; ".join(parts))
     osb = analysis.get("opt_state_bytes")
     if osb:
         p(f"opt state: {osb['last'] / 1e6:.1f} MB/rank "
@@ -374,6 +417,20 @@ def render(analysis: Dict[str, Any], file=None) -> None:
 # ---------------------------------------------------------------------------
 
 
+def must_not_drop(threshold: float):
+    """Shared fractional-drop predicate: B regresses iff it falls more
+    than ``threshold`` below A (throughput/MFU-shaped metrics)."""
+    return lambda va, vb: vb < va * (1.0 - threshold)
+
+
+def must_not_grow(threshold: float, slack: float = 0.0):
+    """Shared fractional-growth predicate: B regresses iff it exceeds A
+    by more than ``threshold`` (plus an absolute ``slack`` floor for
+    near-zero baselines — a 0.001 bubble must not gate on timer noise).
+    Residency-bytes and bubble-fraction-shaped metrics."""
+    return lambda va, vb: vb > va * (1.0 + threshold) + slack
+
+
 def compare(
     a: Sequence[Dict[str, Any]],
     b: Sequence[Dict[str, Any]],
@@ -381,6 +438,7 @@ def compare(
     threshold: float = 0.05,
     hbm_slack_bytes: int = 64 << 20,
     loss_threshold: Optional[float] = None,
+    bubble_threshold: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Compare run B against baseline A; ``regressed`` iff B is worse.
 
@@ -403,6 +461,14 @@ def compare(
     learning progress given back" — the machine gate for paired
     fp32-wire vs quantized-wire training runs (the quantized-collectives
     convergence bar, parallel/quantize.py).
+
+    ``bubble_threshold`` tunes the pipeline bubble-fraction gate
+    independently of ``threshold`` (it defaults to ``threshold`` when
+    journals carry ``bubble_fraction`` stamps): B's bubble fraction must
+    not grow past it — the machine before/after for schedule work
+    (ROADMAP item 5; the analytic floor rides the journal as
+    ``bubble_fraction_expected``). All fractional tolerances share one
+    predicate pair (:func:`must_not_drop` / :func:`must_not_grow`).
     """
     ra, rb = analyze(a), analyze(b)
     checks: List[Dict[str, Any]] = []
@@ -421,7 +487,7 @@ def compare(
     check("tokens_per_sec_p50",
           (ra.get("tokens_per_sec") or {}).get("p50"),
           (rb.get("tokens_per_sec") or {}).get("p50"),
-          worse=lambda va, vb: vb < va * (1.0 - threshold))
+          worse=must_not_drop(threshold))
     # MFU is only comparable against the SAME peak denominator: a
     # baseline armed with an env-calibrated ceiling vs a candidate on
     # the datasheet row would regress ~4x at identical throughput
@@ -431,7 +497,7 @@ def compare(
         check("mfu_p50",
               (ra.get("mfu") or {}).get("p50"),
               (rb.get("mfu") or {}).get("p50"),
-              worse=lambda va, vb: vb < va * (1.0 - threshold))
+              worse=must_not_drop(threshold))
     else:
         checks.append({"check": "mfu_p50", "a": src_a, "b": src_b,
                        "regressed": False,
@@ -473,11 +539,21 @@ def compare(
     check("opt_state_bytes_last",
           (ra.get("opt_state_bytes") or {}).get("last"),
           (rb.get("opt_state_bytes") or {}).get("last"),
-          worse=lambda va, vb: vb > va * (1.0 + threshold))
+          worse=must_not_grow(threshold))
     check("param_bytes_last",
           (ra.get("param_bytes") or {}).get("last"),
           (rb.get("param_bytes") or {}).get("last"),
-          worse=lambda va, vb: vb > va * (1.0 + threshold))
+          worse=must_not_grow(threshold))
+    # pipeline bubble fraction (journals stamped by set_bubble_fraction):
+    # regression = the measured bubble GROWS past the tolerance — the
+    # machine gate schedule rewrites are judged by. The 0.01 absolute
+    # slack keeps near-zero-bubble baselines from gating on timer noise.
+    check("bubble_fraction_p50",
+          ((ra.get("timeline") or {}).get("bubble_fraction") or {}).get("p50"),
+          ((rb.get("timeline") or {}).get("bubble_fraction") or {}).get("p50"),
+          worse=must_not_grow(
+              threshold if bubble_threshold is None else bubble_threshold,
+              slack=0.01))
     regressed = [c["check"] for c in checks if c["regressed"]]
     return {"threshold": threshold, "checks": checks,
             "regressed": regressed, "ok": not regressed,
@@ -508,6 +584,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                        help="arm the convergence gate: candidate final loss "
                             "must be within this fraction of the baseline's "
                             "loss drop (off by default — see compare())")
+        p.add_argument("--bubble-threshold", type=float, default=None,
+                       help="max fractional growth in the pipeline bubble "
+                            "fraction (defaults to --threshold when "
+                            "journals carry bubble_fraction stamps)")
         p.add_argument("--json", action="store_true",
                        help="print the full comparison as one JSON object")
         args = p.parse_args(argv[1:])
@@ -515,7 +595,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       threshold=args.threshold,
                       # MiB, matching compare()'s 64 << 20 default exactly
                       hbm_slack_bytes=int(args.hbm_slack_mb * (1 << 20)),
-                      loss_threshold=args.loss_threshold)
+                      loss_threshold=args.loss_threshold,
+                      bubble_threshold=args.bubble_threshold)
         if args.json:
             print(json.dumps(res))
         else:

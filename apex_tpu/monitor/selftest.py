@@ -32,6 +32,15 @@ that the monitor pieces stay importable and functional:
    reduce with no error-feedback residual leaf; the encoded all_to_all
    pair with a residual passes).
 
+9. tracing: nested spans round-trip with depths and strict-JSON
+   non-finite handling; a torn trace file still parses; the analytic
+   bubble floors and the step-anatomy fraction invariant (compute +
+   exposed-comm + stall == 1.0) hold at hand-computable points; a
+   synthetic 2-rank slot timeline measures the bubble the algebra
+   predicts; Chrome trace export round-trips ``json``; and the
+   untimed-schedule tripwire flags a pipeline drive that emits no spans
+   under an armed tracer (a span-emitting drive passes).
+
 Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
 proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
 
@@ -404,6 +413,120 @@ def _check_lint() -> dict:
             "padding_waste_bytes": pad["waste_bytes"]}
 
 
+def _check_tracing() -> dict:
+    import json as _json
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.lint import trace as lint_trace
+    from apex_tpu.monitor import tracing
+    from apex_tpu.utils.compat import ensure_jax_compat
+
+    ensure_jax_compat()  # jax<0.5: the ring fixture uses lax.axis_size
+
+    # nested spans: depths recorded, barrier stops the clock on a fetch
+    tr = tracing.Tracer(None, meta={"run": "selftest"})
+    with tr.span("step", step=0) as outer:
+        with tr.span("zero.grads", cat="compute") as sp:
+            sp.barrier(jnp.ones((4,)))
+        outer.barrier(jnp.zeros(()))
+    spans = [r for r in tr.records if r["kind"] == "span"]
+    assert [s["name"] for s in spans] == ["zero.grads", "step"], spans
+    assert spans[0]["depth"] == 1 and spans[1]["depth"] == 0, spans
+    assert all(s["dur_s"] >= 0 for s in spans), spans
+
+    # strict JSON: a non-finite attr value sanitizes to null + key path
+    rec = tr.record("bad", dur_s=0.25, cat="host", metric=float("inf"))
+    assert rec["metric"] is None and "metric" in rec["nonfinite_keys"], rec
+    _json.loads(_json.dumps(rec))  # must be strict-parseable
+
+    # torn trace files parse (journal read semantics shared verbatim)
+    fd, path = tempfile.mkstemp(prefix="apex_tpu_trace_", suffix=".jsonl")
+    os.close(fd)
+    try:
+        with tracing.Tracer(path) as ftr:
+            with ftr.span("a"):
+                pass
+        with open(path, "a") as f:
+            f.write('{"kind": "span", "trunc')
+        rows = tracing.Tracer.read(path)
+        assert rows.truncated and rows.bad_lines == 1 and len(rows) == 1, rows
+    finally:
+        os.unlink(path)
+
+    # analytic floors at hand-computable points: the SPMD ring's
+    # (S-1)/(vpp*M+S-1), 1F1B's (S-1)/(M+S-1), the zero-bubble target
+    ebf = tracing.expected_bubble_fraction
+    assert abs(ebf("interleaved", 8, 4, 2) - 3 / 19) < 1e-12
+    assert abs(ebf("1f1b", 8, 4) - 3 / 11) < 1e-12
+    assert ebf("zero-bubble", 8, 4) == 0.0
+    assert ebf("interleaved", 8, 1) == 0.0  # no pipeline, no bubble
+
+    # anatomy invariant at a hand point: 0.06s compute + 0.06s comm in a
+    # 0.1s wall → 0.02s overlapped (1/3 of the cheaper side), fractions
+    # summing to exactly 1.0
+    an = tracing.step_anatomy(wall_s=0.1, compute_s=0.06, comm_s=0.06)
+    assert abs(an["overlap_fraction"] - 1 / 3) < 1e-3, an
+    assert abs(an["compute_frac"] + an["comm_frac"]
+               + an["stall_frac"] - 1.0) < 1e-6, an
+
+    # synthetic 2-rank slot timeline: M=3 units, S=2 → 4 ticks, 1 idle
+    # slot per rank per direction → measured bubble = 1/4 exactly
+    syn = tracing.Tracer(None)
+    for phase in ("fwd", "bwd"):
+        for t in range(4):
+            for s in range(2):
+                live = 0 <= t - s < 3
+                syn.record(phase if live else "bubble", dur_s=0.01,
+                           cat="pipe", rank=s, tick=t, phase=phase,
+                           microbatch=(t - s) if live else None)
+    pa = tracing.pipeline_anatomy(syn.records)
+    assert abs(pa["bubble_fraction"]["mean"] - 0.25) < 1e-6, pa
+    assert abs(pa["bubble_fraction"]["mean"]
+               - ebf("1f1b", 3, 2)) < 1e-6, pa
+
+    # Chrome export round-trips json with one complete event per span
+    # plus per-rank process metadata
+    trace = _json.loads(_json.dumps(tracing.chrome_trace(syn.records)))
+    ev = trace["traceEvents"]
+    assert len([e for e in ev if e["ph"] == "X"]) == 16, len(ev)
+    assert {e["pid"] for e in ev} == {0, 1}, ev
+    assert all(e["ts"] >= 0 and e.get("dur", 0) >= 0 for e in ev
+               if e["ph"] == "X"), ev
+    assert not any(math.isnan(e["ts"]) for e in ev if e["ph"] == "X")
+
+    # untimed-schedule tripwire: a compiled ring drive under an armed
+    # tracer with no spans is the census-only regression; a drive that
+    # emits pipe spans passes
+    from apex_tpu.transformer.pipeline_parallel import schedules
+
+    run_stage = lambda lp, h: h * (1.0 + jnp.sum(lp))  # noqa: E731
+    layers_l = jnp.ones((4, 2, 2))
+    h_mb = jnp.ones((4, 3, 5))
+    ring = jax.vmap(
+        lambda ll, hm: schedules._pipeline_ring(run_stage, ll, hm, "i"),
+        axis_name="i")
+
+    bad = lint_trace.untimed_schedule_hazards(
+        lambda: jax.make_jaxpr(ring)(layers_l, h_mb))
+    assert bad["hazard"] and bad["drives"] == 1, bad
+    assert bad["findings"][0]["rule"] == "untimed-schedule", bad
+
+    def timed_drive():
+        from apex_tpu.monitor import tracing as tmod
+
+        jax.make_jaxpr(ring)(layers_l, h_mb)
+        tmod.get_tracer().record("fwd", dur_s=0.01, cat="pipe", rank=0)
+
+    ok = lint_trace.untimed_schedule_hazards(timed_drive)
+    assert not ok["hazard"] and ok["pipe_spans"] == 1, ok
+    return {"ok": True, "spans": len(spans),
+            "synthetic_bubble": pa["bubble_fraction"]["mean"],
+            "chrome_events": len(ev)}
+
+
 def run() -> dict:
     """In-process smoke (no platform mutation — safe under any backend)."""
     results = {}
@@ -414,7 +537,8 @@ def run() -> dict:
                      ("mfu", _check_mfu),
                      ("diagnose", _check_diagnose),
                      ("report", _check_report),
-                     ("lint", _check_lint)):
+                     ("lint", _check_lint),
+                     ("tracing", _check_tracing)):
         try:
             results[name] = fn()
         except Exception as e:  # noqa: BLE001 - report, don't crash the gate

@@ -74,6 +74,8 @@ def build_zero_train_step(
     num_microbatches: Optional[int] = None,
     virtual_pipeline_size: int = 1,
     with_aux: bool = False,
+    traced: bool = False,
+    tracer=None,
 ):
     """One jitted GPT train step with the whole ZeRO update inside a single
     ``shard_map``: backward, spec-aware grad reduction over every
@@ -115,6 +117,19 @@ def build_zero_train_step(
     Returns ``train_step(params, opt_state, tokens, targets) ->
     (params, opt_state, loss, metrics)`` with the loss unscaled; at level
     3 ``params`` is the persistent chunk tree (``zero3.params``).
+
+    ``traced=True`` (the ``--trace``/``BENCH_TRACE`` opt-in) splits the
+    step into its two anatomy phases — backward+reduction
+    (``zero.grads``, the ZeRO-3 just-in-time gathers and their
+    reduce-scatter transposes live here) and the sharded-optimizer
+    update (``zero.apply``: the level-1/2 grad psum_scatter + param
+    all_gather) — each its own jitted program wrapped in a
+    ``monitor.tracing`` span with a device→host fetch barrier and the
+    phase's traced collective payload bytes attached, so journals and
+    ``monitor.report``'s timeline section get measured phase seconds
+    instead of a single opaque wall time. Identical math (same programs'
+    contents, one extra host handoff); ``traced=False`` (default) builds
+    the ORIGINAL single-program step — byte-identical, tier-1 pins it.
     """
     from apex_tpu.parallel import collectives
     from apex_tpu.parallel.distributed import (
@@ -197,6 +212,26 @@ def build_zero_train_step(
             out_specs=(zero3.param_specs, zero3.state_specs,
                        PartitionSpec(), PartitionSpec()),
             check_vma=False)
+
+        if traced:
+            # the grads phase owns the per-layer JIT gathers and their
+            # reduce-scatter transposes — the ZeRO-3 gather/scatter span
+            def traced_grads(p, opt_state, toks, tgts):
+                rest_c = {k: v for k, v in p.items() if k != "layers"}
+
+                def scaled_loss(rest_c, layer_c):
+                    rest = gather_chunked_tree(rest_c, rest_meta)
+                    return pipe_loss3(rest, layer_c, toks, tgts) \
+                        * opt_state.scaler.loss_scale
+
+                loss, (rest_g, layer_g) = jax.value_and_grad(
+                    scaled_loss, argnums=(0, 1))(rest_c, p["layers"])
+                rest_g, layer_g = reduce_nonzero(rest_g, layer_g)
+                return (collectives.pmean(loss, grad_axes),
+                        rest_g, layer_g)
+
+            traced_param_specs = zero3.param_specs
+            traced_state_specs = zero3.state_specs
     else:
 
         def zero_step(p, opt_state, toks, tgts):
@@ -220,6 +255,102 @@ def build_zero_train_step(
             in_specs=(specs, state_specs, data_spec, data_spec),
             out_specs=(specs, state_specs, PartitionSpec(), PartitionSpec()),
             check_vma=False)
+
+        if traced:
+
+            def traced_grads(p, opt_state, toks, tgts):
+                rest = {k: v for k, v in p.items() if k != "layers"}
+
+                def scaled_loss(rest, layers):
+                    return pipe_loss(rest, layers, toks, tgts) \
+                        * opt_state.scaler.loss_scale
+
+                loss, (rest_g, layer_g) = jax.value_and_grad(
+                    scaled_loss, argnums=(0, 1))(rest, p["layers"])
+                rest_g, layer_g = reduce_nonzero(rest_g, layer_g)
+                return (collectives.pmean(loss, grad_axes),
+                        rest_g, layer_g)
+
+            traced_param_specs = specs
+            traced_state_specs = state_specs
+
+    if traced:
+        # the two-phase anatomy build (docstring): same math, two jitted
+        # programs, host spans with fetch barriers between them. The
+        # apply phase is where the level-1/2 gather/scatter collectives
+        # live (psum_scatter + compressed all_gather); at level 3 those
+        # ride the grads phase's per-layer gather transposes instead.
+        from apex_tpu.monitor import comms as comms_mod
+        from apex_tpu.monitor import tracing as tracing_mod
+
+        rest_gspecs = {k: v for k, v in traced_param_specs.items()
+                       if k != "layers"}
+        layer_gspecs = traced_param_specs["layers"]
+
+        def traced_apply(p, opt_state, rest_g, layer_g):
+            return mp_opt.apply_gradients(
+                opt_state, p, dict(rest_g, layers=layer_g),
+                found_inf_reducer=reducer)
+
+        grad_fn = jax.jit(jax.shard_map(
+            traced_grads, mesh=mesh,
+            in_specs=(traced_param_specs, traced_state_specs,
+                      data_spec, data_spec),
+            out_specs=(PartitionSpec(), rest_gspecs, layer_gspecs),
+            check_vma=False))
+        apply_fn = jax.jit(jax.shard_map(
+            traced_apply, mesh=mesh,
+            in_specs=(traced_param_specs, traced_state_specs,
+                      rest_gspecs, layer_gspecs),
+            out_specs=(traced_param_specs, traced_state_specs,
+                       PartitionSpec()),
+            check_vma=False))
+
+        phase_comm: dict = {}
+
+        def _arm_phase_bytes(key, fn, *args) -> None:
+            # join each phase span with the comm: scope byte accounting
+            # (monitor/comms.py): ONE extra trace per phase, host-side,
+            # so every span carries the phase's collective payload bytes
+            try:
+                with comms_mod.comm_accounting() as acct:
+                    jax.make_jaxpr(fn)(*args)
+                phase_comm[key] = acct.total_bytes()
+            except Exception:  # noqa: BLE001 - telemetry must not kill a run
+                phase_comm[key] = None
+
+        def traced_train_step(params, opt_state, tokens, targets):
+            tr = tracer if tracer is not None else tracing_mod.get_tracer()
+            try:
+                # a jax re-trace of this step (mfu arming, cost censuses)
+                # executes the body with abstract values — suppress the
+                # spans, a trace-time "duration" is not a measurement
+                if tr is not None and not jax.core.trace_state_clean():
+                    tr = None
+            except Exception:  # noqa: BLE001 - older/newer jax: keep spans
+                pass
+            if "grads" not in phase_comm:
+                _arm_phase_bytes("grads", grad_fn,
+                                 params, opt_state, tokens, targets)
+            with tracing_mod.maybe_span(
+                    tr, "zero.grads", cat="compute",
+                    comm_bytes=phase_comm.get("grads")) as sp:
+                scaled, rest_g, layer_g = grad_fn(
+                    params, opt_state, tokens, targets)
+                sp.barrier(scaled)
+            if "apply" not in phase_comm:
+                _arm_phase_bytes("apply", apply_fn,
+                                 params, opt_state, rest_g, layer_g)
+            with tracing_mod.maybe_span(
+                    tr, "zero.apply", cat="comm",
+                    comm_bytes=phase_comm.get("apply")) as sp:
+                new_p, new_state, metrics = apply_fn(
+                    params, opt_state, rest_g, layer_g)
+                sp.barrier(metrics["loss_scale"])
+            return (new_p, new_state,
+                    scaled / opt_state.scaler.loss_scale, metrics)
+
+        return traced_train_step
 
     @jax.jit
     def train_step(params, opt_state, tokens, targets):

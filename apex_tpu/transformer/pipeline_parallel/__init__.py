@@ -6,4 +6,6 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     pipeline_specs,
     pipelined_loss_fn,
     prepare_pipelined_model,
+    ring_drive_count,
+    traced_pipeline_timeline,
 )

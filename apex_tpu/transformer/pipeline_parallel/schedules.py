@@ -51,7 +51,8 @@ reference, ``M`` must divide by ``S`` when ``vpp > 1``
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+import time
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +64,18 @@ from apex_tpu.parallel.mesh import AXIS_PIPE
 from apex_tpu.transformer.tensor_parallel.mappings import (
     reduce_from_tensor_model_parallel_region as _psum_identity_bwd,
 )
+
+# schedule-drive trace counter: bumped whenever a pipeline ring is traced
+# (the compiled scan) or a traced tick drive runs — the observable the
+# ``lint.trace.untimed_schedule_hazards`` tripwire joins against span
+# output (a drive that traced while a tracer was armed but emitted no
+# pipe spans is the census-only regression this counter exists to catch).
+_RING_DRIVES = 0
+
+
+def ring_drive_count() -> int:
+    """Process-global count of pipeline-ring drives traced so far."""
+    return _RING_DRIVES
 
 
 def pipeline_specs(specs: Any, axis: str = AXIS_PIPE) -> Any:
@@ -192,6 +205,8 @@ def _pipeline_ring(
     moves every in-flight item, with finished items exiting the ring on the
     ticks when stage 0 injects fresh microbatches.
     """
+    global _RING_DRIVES
+    _RING_DRIVES += 1
     S = lax.axis_size(axis)
     s_idx = lax.axis_index(axis)
     M = h_microbatches.shape[0]
@@ -437,6 +452,300 @@ def forward_backward_no_pipelining(
     (loss, grads), _ = lax.scan(body, (jnp.zeros(()), zero_grads), (b_mb, t_mb))
     scale = 1.0 / M
     return loss * scale, jax.tree.map(lambda g: g * scale, grads)
+
+
+def traced_pipeline_timeline(
+    mesh: Any,
+    *,
+    embed: Callable[[Any, Any], jax.Array],
+    run_layers: Callable[[Any, jax.Array], jax.Array],
+    head_loss: Callable[[Any, jax.Array, Any], jax.Array],
+    rest_params: Any,
+    layers: Any,
+    layer_specs: Any,
+    batch: Any,
+    targets: Any,
+    num_microbatches: int,
+    virtual_pipeline_size: int = 1,
+    axis: str = AXIS_PIPE,
+    tracer: Any = None,
+    step: int = 0,
+    warmup: bool = True,
+):
+    """Tick-by-tick eager drive of the SAME interleaved ring the compiled
+    ``pipelined_loss_fn`` scans — the measurement substrate for step
+    anatomy (veScale-style eager-observable SPMD, PAPERS.md): each tick's
+    compute and its ppermute run as separate jitted device calls with a
+    device→host fetch barrier between them, so every 1F1B/vpp slot lands
+    as a per-rank span ({fwd, bwd, send, recv}; idle fill/drain slots as
+    ``bubble``) and the per-rank bubble fraction is MEASURED instead of
+    asserted from the tick algebra.
+
+    The backward is driven explicitly in reverse: each tick's VJP
+    recomputes the tick under ``jax.vjp`` inside one jitted call (the
+    same rematerialize-in-backward semantics the compiled scan pays),
+    with the ppermute transpose (the inverse ring) timed as its own
+    send/recv slot. Loss AND grads equal the compiled pipelined loss —
+    tier-1 pins the equivalence against the serial model — so the
+    timeline is the anatomy of the real computation, not a mock.
+
+    Restrictions (an observability drive, not a training path): the mesh
+    region must be pipe-only for the layer stack (``layer_specs`` =
+    :func:`pipeline_specs` output; no TP axis inside ``run_layers``),
+    ``run_layers`` must not emit aux losses, dropout must be off, and
+    the drive retains per-tick carries for the backward (O(ticks ×
+    microbatch) activations — fine at probe scale, do not 512k-token it).
+
+    Args mirror :func:`pipelined_loss_fn`; ``layers`` must already be
+    :func:`interleave_stack`-permuted when ``virtual_pipeline_size > 1``
+    and sharded over ``axis``. ``tracer`` (or the armed global
+    ``monitor.tracing`` tracer) receives the spans; pass None to only
+    get the returned anatomy.
+
+    Returns ``(loss, grads, anatomy)``: the scalar full-batch mean loss,
+    ``grads = {"layers": <in the given interleaved order>, **rest}``,
+    and the anatomy dict (per-rank slot seconds, measured
+    ``bubble_fraction``, the analytic
+    ``expected_bubble_fraction`` floor, per-microbatch slot timings).
+    """
+    global _RING_DRIVES
+    _RING_DRIVES += 1
+    from apex_tpu.monitor import tracing as tracing_mod
+    from apex_tpu.utils.compat import ensure_jax_compat
+
+    ensure_jax_compat()  # jax<0.5 shard_map rename (library-safe, idempotent)
+    from jax.sharding import NamedSharding
+
+    tr = tracer if tracer is not None else tracing_mod.get_tracer()
+    # every span ALSO lands in this in-memory collector, so the returned
+    # anatomy is derived through the one rollup implementation
+    # (tracing.pipeline_anatomy) whether or not a tracer is armed
+    collector = tracing_mod.Tracer(None)
+    M = int(num_microbatches)
+    vpp = int(virtual_pipeline_size)
+    S = int(mesh.shape[axis])
+    if vpp > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) divisible "
+            f"by pipeline size ({S}), as in the reference")
+    n_units = vpp * M
+    n_ticks = pipeline_tick_count(M, S, vpp)
+    L = jax.tree.leaves(layers)[0].shape[0]
+    if L % S:
+        raise ValueError(f"layer count ({L}) must divide by stages ({S})")
+    n_local = L // S
+    if n_local % vpp:
+        raise ValueError(
+            f"per-stage layer count ({n_local}) must divide by vpp ({vpp})")
+    per = n_local // vpp
+
+    def _record(name: str, **kw) -> None:
+        collector.record(name, **kw)
+        if tr is not None:
+            tr.record(name, **kw)
+
+    def _tick_spans(t: int, dur: float, *, phase: str, wall0: float) -> None:
+        """One measured tick interval → S per-rank slot spans."""
+        for s in range(S):
+            k_raw = t - s
+            live = 0 <= k_raw < n_units
+            attrs: Dict[str, Any] = {"tick": t, "stage": s,
+                                     "phase": phase, "step": step}
+            if live:
+                j = k_raw % S
+                q = (k_raw // S) % vpp
+                attrs["microbatch"] = (k_raw // (S * vpp)) * S + j
+                attrs["chunk"] = q
+            _record(phase if live else "bubble", dur_s=dur,
+                    cat="pipe", rank=s, ts=wall0, **attrs)
+
+    def _comm_spans(t: int, dur: float, *, phase: str, wall0: float) -> None:
+        """One measured ppermute interval → send+recv spans per rank (the
+        ring: every rank sends to s+1 and receives from s-1 each tick;
+        the transposed ring in the backward inverts the peers)."""
+        fwd = phase == "fwd"
+        for s in range(S):
+            to_peer = (s + 1) % S if fwd else (s - 1) % S
+            from_peer = (s - 1) % S if fwd else (s + 1) % S
+            _record("send", dur_s=dur, cat="pipe-comm", rank=s,
+                    ts=wall0, tick=t, stage=s, phase=phase,
+                    peer=to_peer, step=step)
+            _record("recv", dur_s=dur, cat="pipe-comm", rank=s,
+                    ts=wall0, tick=t, stage=s, phase=phase,
+                    peer=from_peer, step=step)
+
+    # -- embed (replicated work, outside the ring) --------------------------
+    wall0, t0 = time.time(), time.perf_counter()
+    h, vjp_embed = jax.vjp(lambda p: embed(p, batch), rest_params)
+    tracing_mod.fetch_barrier(h)
+    if tr is not None:
+        tr.record("embed", dur_s=time.perf_counter() - t0, cat="compute",
+                  ts=wall0, phase="fwd", step=step)
+    bsz = h.shape[0]
+    if bsz % M:
+        raise ValueError(f"batch ({bsz}) must divide by microbatches ({M})")
+    h_mb = h.reshape((M, bsz // M) + h.shape[1:])
+    mb_shape = h_mb.shape[1:]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    perm_inv = [(j, i) for i, j in perm]
+
+    # -- the per-tick programs (compiled once, reused every tick) -----------
+    def _compute(buf, out, layers_loc, h_mb_l, t):
+        s_idx = lax.axis_index(axis)
+        k_raw = t - s_idx
+        k = jnp.clip(k_raw, 0, n_units - 1)
+        j = k % S
+        q = (k // S) % vpp
+        m = (k // (S * vpp)) * S + j
+        inject = (s_idx == 0) & (q == 0)
+        h_in = jnp.where(
+            inject,
+            lax.dynamic_index_in_dim(h_mb_l, m, 0, keepdims=False),
+            buf[0])
+        if vpp == 1:
+            chunk = layers_loc
+        else:
+            chunk = jax.tree.map(
+                lambda x: lax.dynamic_slice_in_dim(x, q * per, per, axis=0),
+                layers_loc)
+        h_out = run_layers(chunk, h_in)
+        if isinstance(h_out, tuple):
+            if h_out[1] is not None:
+                raise ValueError(
+                    "traced_pipeline_timeline does not support aux-emitting "
+                    "layers (MoE routers) — time the dense ring")
+            h_out = h_out[0]
+        live = (k_raw >= 0) & (k_raw < n_units)
+        finished = (s_idx == S - 1) & (q == vpp - 1) & live
+        cur = lax.dynamic_index_in_dim(out[0], m, 0, keepdims=False)
+        out_new = lax.dynamic_update_index_in_dim(
+            out[0], jnp.where(finished, h_out, cur), m, 0)
+        return h_out[None], out_new[None]
+
+    compute_sm = jax.shard_map(
+        _compute, mesh=mesh,
+        in_specs=(P(axis), P(axis), layer_specs, P(), P()),
+        out_specs=(P(axis), P(axis)), check_vma=False)
+    compute_fwd = jax.jit(compute_sm)
+
+    @jax.jit
+    def compute_bwd(buf, out, layers_loc, h_mb_l, t, g_hout, g_out,
+                    g_l_acc, g_hm_acc):
+        # rematerialize the tick under vjp INSIDE one jitted call: one
+        # compile covers every backward tick, and the recompute mirrors
+        # the remat the compiled scan's backward pays anyway
+        _, vjp = jax.vjp(
+            lambda b, o, l, hm: compute_sm(b, o, l, hm, t),
+            buf, out, layers_loc, h_mb_l)
+        g_buf, g_out_prev, g_l, g_hm = vjp((g_hout, g_out))
+        return (g_buf, g_out_prev,
+                jax.tree.map(jnp.add, g_l_acc, g_l), g_hm_acc + g_hm)
+
+    permute_fwd = jax.jit(jax.shard_map(
+        lambda x: lax.ppermute(x, axis, perm), mesh=mesh,
+        in_specs=P(axis), out_specs=P(axis), check_vma=False))
+    permute_bwd = jax.jit(jax.shard_map(
+        lambda x: lax.ppermute(x, axis, perm_inv), mesh=mesh,
+        in_specs=P(axis), out_specs=P(axis), check_vma=False))
+
+    # carries committed to the ring sharding up front, so every tick hits
+    # the same compiled program (an unsharded zeros carry at tick 0 would
+    # recompile AND time the compile into the first span)
+    ring_sharding = NamedSharding(mesh, P(axis))
+    buf = jax.device_put(jnp.zeros((S,) + mb_shape, h.dtype), ring_sharding)
+    out = jax.device_put(jnp.zeros((S, M) + mb_shape, h.dtype),
+                         ring_sharding)
+    g_layers0 = jax.tree.map(jnp.zeros_like, layers)
+    g_hmb0 = jnp.zeros_like(h_mb)
+
+    if warmup:
+        # compile all four tick programs outside the measured spans —
+        # TWO chained iterations each way, because the loop's second
+        # iteration feeds each program its own outputs back (committed
+        # shardings can differ from the hand-placed initial carries, and
+        # a cache miss inside the measured region would land a ~compile
+        # worth of wall time on whichever slot it hits, wrecking the
+        # bubble-fraction measurement)
+        tt0 = jnp.asarray(0, jnp.int32)
+        h_w, o_w = compute_fwd(buf, out, layers, h_mb, tt0)
+        b_w = permute_fwd(h_w)
+        h_w2, o_w2 = compute_fwd(b_w, o_w, layers, h_mb, tt0)
+        g_w = permute_bwd(b_w)
+        r1 = compute_bwd(buf, out, layers, h_mb, tt0,
+                         g_w, jnp.zeros_like(o_w), g_layers0, g_hmb0)
+        g_w2 = permute_bwd(r1[0])
+        r2 = compute_bwd(b_w, o_w, layers, h_mb, tt0,
+                         g_w2, r1[1], r1[2], r1[3])
+        tracing_mod.fetch_barrier(r2[0])
+
+    # -- forward ticks ------------------------------------------------------
+    saved = []
+    for t in range(n_ticks):
+        tt = jnp.asarray(t, jnp.int32)
+        saved.append((buf, out, tt))
+        wall0, t0 = time.time(), time.perf_counter()
+        h_out, out = compute_fwd(buf, out, layers, h_mb, tt)
+        tracing_mod.fetch_barrier(h_out)
+        _tick_spans(t, time.perf_counter() - t0, phase="fwd", wall0=wall0)
+        wall0, t0 = time.time(), time.perf_counter()
+        buf = permute_fwd(h_out)
+        tracing_mod.fetch_barrier(buf)
+        _comm_spans(t, time.perf_counter() - t0, phase="fwd", wall0=wall0)
+
+    # -- head (replicated loss on the last stage's finished rows) -----------
+    wall0, t0 = time.time(), time.perf_counter()
+    out_last = out[S - 1]
+    h_full = out_last.reshape((bsz,) + out_last.shape[2:])
+    loss, vjp_head = jax.vjp(
+        lambda r, hf: jnp.mean(head_loss(r, hf, targets)), rest_params,
+        h_full)
+    tracing_mod.fetch_barrier(loss)
+    if tr is not None:
+        tr.record("head", dur_s=time.perf_counter() - t0, cat="compute",
+                  ts=wall0, phase="fwd", step=step)
+
+    # -- backward ticks (the transposed ring, driven in reverse) ------------
+    g_rest_h, g_hfull = vjp_head(jnp.ones_like(loss))
+    g_out = jnp.zeros_like(out).at[S - 1].set(
+        g_hfull.reshape((M,) + mb_shape))
+    g_buf = jnp.zeros_like(buf)
+    g_layers, g_hmb = g_layers0, g_hmb0
+    for t in reversed(range(n_ticks)):
+        sbuf, sout, tt = saved[t]
+        wall0, t0 = time.time(), time.perf_counter()
+        g_hout = permute_bwd(g_buf)
+        tracing_mod.fetch_barrier(g_hout)
+        _comm_spans(t, time.perf_counter() - t0, phase="bwd", wall0=wall0)
+        wall0, t0 = time.time(), time.perf_counter()
+        g_buf, g_out, g_layers, g_hmb = compute_bwd(
+            sbuf, sout, layers, h_mb, tt, g_hout, g_out, g_layers, g_hmb)
+        tracing_mod.fetch_barrier(g_buf)
+        _tick_spans(t, time.perf_counter() - t0, phase="bwd", wall0=wall0)
+
+    wall0, t0 = time.time(), time.perf_counter()
+    (g_rest_e,) = vjp_embed(g_hmb.reshape(h.shape))
+    rest_grads = jax.tree.map(jnp.add, g_rest_h, g_rest_e)
+    tracing_mod.fetch_barrier(jax.tree.leaves(rest_grads)[0])
+    if tr is not None:
+        tr.record("embed", dur_s=time.perf_counter() - t0, cat="compute",
+                  ts=wall0, phase="bwd", step=step)
+
+    # -- anatomy: the ONE rollup implementation (tracing.pipeline_anatomy)
+    # over the in-memory collector, so a tracer-armed run and the
+    # returned dict can never disagree
+    pa = tracing_mod.pipeline_anatomy(collector.records)
+    anatomy = {
+        "schedule": "interleaved",
+        "stages": S, "vpp": vpp, "num_microbatches": M,
+        "ticks": n_ticks, "units": n_units,
+        "expected_bubble_fraction": round(
+            tracing_mod.expected_bubble_fraction(
+                "interleaved", M, S, virtual_pipeline_size=vpp), 4),
+        "per_rank": pa["ranks"],
+        "bubble_fraction": pa["bubble_fraction"],
+        "microbatches": pa.get("microbatches", {}),
+    }
+    return loss, dict(rest_grads, layers=g_layers), anatomy
 
 
 def get_forward_backward_func(

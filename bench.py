@@ -63,7 +63,9 @@ state, grad-norm, HBM occupancy sample — plus, for the GPT rungs,
 mfu/hbm_bw_util/bound joined from one extra trace against the peak-spec
 table, monitor/mfu.py; override the tunnel chip's measured ceiling via
 APEX_TPU_PEAK_FLOPS / APEX_TPU_PEAK_HBM_GBPS) to that file via
-apex_tpu.monitor.MetricsJournal; unset, the compiled programs are
+apex_tpu.monitor.MetricsJournal; BENCH_TRACE=<path> additionally lands
+one measured span per timed window in a monitor.tracing span file
+(chrome://tracing-exportable); unset, the compiled programs are
 byte-identical to un-instrumented rounds. Journals analyze offline with
 `python -m apex_tpu.monitor.report <path>` (percentiles, stalls, spikes,
 HBM trend) and gate with `... report compare A B` (exit 1 on regression).
@@ -93,6 +95,29 @@ WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
 # appends (O_APPEND, one JSON object per line) to ONE shared journal file;
 # False means "tried and failed, stay off".
 _JOURNAL = None
+
+# process-global span tracer (apex_tpu.monitor.tracing), armed by
+# BENCH_TRACE=<path>: every timed window lands one measured span (the
+# window's device-barriered wall time), shareable across subprocess
+# phases like the journal; chrome-exportable via
+# monitor.tracing.write_chrome_trace. Unset: byte-identical programs.
+_TRACER = None
+
+
+def _get_tracer():
+    global _TRACER
+    path = os.environ.get("BENCH_TRACE")
+    if not path:
+        return None
+    if _TRACER is None:
+        try:
+            from apex_tpu.monitor import tracing
+
+            _TRACER = tracing.arm(path)
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill bench
+            print(f"bench tracer disabled: {e}", file=sys.stderr)
+            _TRACER = False
+    return _TRACER or None
 
 
 def _get_journal():
@@ -231,6 +256,13 @@ def _timed_windows(advance, get_loss, *, steps, windows, per_window_units,
         dt = time.perf_counter() - t0
         assert jnp.isfinite(loss_val), "non-finite loss in bench"
         rates.append(per_window_units / dt)
+        tracer = _get_tracer()
+        if tracer is not None:
+            # the loss fetch above already barriered the device; the span
+            # is the window's measured wall, post-hoc
+            tracer.record("window", dur_s=dt, cat="host",
+                          label=label or "window", window=i, steps=steps,
+                          rate=round(per_window_units / dt, 1))
         if journal is not None:
             journal.step_end(
                 loss=loss_val, wall_s=dt, tokens=per_window_units,

@@ -231,6 +231,30 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         except Exception as e:  # noqa: BLE001 - mfu is best-effort evidence
             row["mfu"] = {"error": str(e)[:120]}
         try:
+            # step-anatomy timeline per config (monitor/tracing.py): the
+            # analytic bubble floor for this pp/M shape plus the measured
+            # wall decomposed into compute/exposed-comm/stall fractions
+            # (cost-model FLOPs over the peak spec, traced comm bytes
+            # over the ICI table — fractions sum to 1.0 by construction)
+            # and the modeled comm/compute overlap fraction. Host-side
+            # only; the labelled-emulation caveat of the mfu block
+            # applies on the CPU virtual mesh.
+            from apex_tpu.monitor import tracing as tracing_lib
+
+            tl = {
+                "expected_bubble_fraction": round(
+                    tracing_lib.expected_bubble_fraction(
+                        "interleaved", n_micro, pp), 4) if pp > 1 else 0.0,
+            }
+            flops = (row.get("mfu") or {}).get("achieved_tflops")
+            tl["anatomy"] = tracing_lib.step_anatomy(
+                wall_s=dt,
+                flops=(flops * 1e12 * dt) if flops else None,
+                comm_bytes=comm_acct.total_bytes())
+            row["timeline"] = tl
+        except Exception as e:  # noqa: BLE001 - timeline is best-effort
+            row["timeline"] = {"error": str(e)[:120]}
+        try:
             # static hazard scan per config (apex_tpu/lint/trace.py):
             # lane-padding waste at HBM/custom-call boundaries of THIS
             # step's jaxpr + weak-type/python-scalar signature leaks.
@@ -380,6 +404,16 @@ _TABLE_NOTES = {
         "leaves in the jitted signature. Both trace-time estimates, "
         "backend-independent - actionable on TPU even when measured on "
         "the CPU mesh."),
+    "timeline": (
+        "per-config step anatomy (apex_tpu/monitor/tracing.py): "
+        "expected_bubble_fraction is the analytic fill/drain floor of "
+        "the SPMD ring at this pp/num_microbatches shape; anatomy "
+        "decomposes the measured iteration into compute/exposed-comm/"
+        "stall fractions (summing to 1.0) from the cost model and the "
+        "ICI bandwidth table (calibrate via APEX_TPU_PEAK_ICI_GBPS). "
+        "MEASURED per-rank bubble fractions come from the traced tick "
+        "drive (overlap_evidence.py --timeline / pretrain_gpt --trace), "
+        "not this block."),
     "overlap": (
         "overlap.async_pairs reflects the CPU backend's synchronous "
         "collective lowering, not TPU behavior. TPU-targeted async "

@@ -795,6 +795,252 @@ def _zero_main(args) -> int:
     return 0 if record["ok"] else 1
 
 
+def _timeline_main(args) -> int:
+    """``--timeline``: the EXECUTED step-anatomy evidence record
+    (out/timeline_evidence.json) — unlike the trace-only modes this one
+    runs on the CPU virtual mesh: a vpp-pipelined tick drive
+    (``schedules.traced_pipeline_timeline``) measures per-rank bubble
+    fraction against the analytic ``expected_bubble_fraction`` floor
+    (loss pinned against the serial model), the untimed-schedule
+    tripwire flags the compiled ring while the traced drive passes,
+    traced ZeRO/ZeRO-3 steps decompose into grads/apply phase spans
+    whose anatomy fractions sum to 1.0 per window, and the whole span
+    file exports to a loadable Chrome trace."""
+    # executed mode: force the 8-device virtual CPU mesh BEFORE first
+    # backend use (XLA_FLAGS is read at backend init)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - backend already up: run on it
+        pass
+
+    from apex_tpu import amp
+    from apex_tpu.lint import trace as lint_trace
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.monitor import tracing
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer import tensor_parallel as tp_mod
+    from apex_tpu.transformer.amp import build_zero_train_step
+    from apex_tpu.transformer.pipeline_parallel import (
+        pipeline_specs,
+        pipelined_loss_fn,
+        prepare_pipelined_model,
+        traced_pipeline_timeline,
+    )
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        interleave_stack,
+    )
+
+    S, vpp, M = 4, 2, 4
+    tiny = dict(vocab_size=128, hidden_size=32, num_layers=8,
+                num_attention_heads=4, max_seq_len=16, hidden_dropout=0.0,
+                compute_dtype=jnp.float32, remat=False)
+    record = {"metric": "timeline_evidence", "stages": S, "vpp": vpp,
+              "num_microbatches": M,
+              "model": {k: (v if isinstance(v, (int, float)) else str(v))
+                        for k, v in tiny.items()}}
+    checks = {}
+
+    output = args.output or os.path.join("out", "timeline_evidence.json")
+    out_dir = os.path.dirname(output) or "."
+    os.makedirs(out_dir, exist_ok=True)
+    trace_path = os.path.join(out_dir, "timeline_trace.jsonl")
+    if os.path.exists(trace_path):
+        os.unlink(trace_path)  # span files append; one run = one file
+    tracer = tracing.Tracer(trace_path, keep=True,
+                            meta={"run": "timeline_evidence"})
+
+    # -- measured vpp bubble fraction vs the analytic floor ----------------
+    try:
+        mesh = mesh_lib.make_virtual_mesh(
+            S, pipeline_model_parallel_size=S)
+        model = GPTModel(GPTConfig(axis=None, **tiny))
+        params = model.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                  tiny["vocab_size"])
+        tgt = jnp.roll(toks, -1, axis=-1)
+        specs = model.specs()
+        layer_specs = pipeline_specs(specs["layers"])
+        layers_sh = tp_mod.shard_params(
+            interleave_stack(params["layers"], S, vpp), layer_specs, mesh)
+        rest = {k: v for k, v in params.items() if k != "layers"}
+
+        loss, _, anatomy = traced_pipeline_timeline(
+            mesh, embed=model.embed,
+            run_layers=lambda lp, h: model.run_layers(lp, h),
+            head_loss=lambda p, h, t: model.head(p, h, t),
+            rest_params=rest, layers=layers_sh, layer_specs=layer_specs,
+            batch=toks, targets=tgt, num_microbatches=M,
+            virtual_pipeline_size=vpp, tracer=tracer, step=0)
+        record["pipeline"] = anatomy
+        expected = anatomy["expected_bubble_fraction"]
+        measured = anatomy["bubble_fraction"]["mean"]
+        # contended-container tolerance: half the floor, 0.04 abs min
+        checks["bubble_within_tolerance"] = bool(
+            abs(measured - expected) <= max(0.04, 0.5 * expected))
+        serial_loss = float(model.loss(params, toks, tgt))
+        record["loss"] = {"traced_drive": round(float(loss), 6),
+                          "serial": round(serial_loss, 6)}
+        checks["loss_matches_serial"] = bool(
+            abs(float(loss) - serial_loss) < 1e-4)
+
+        # the tripwire this PR exists to prevent: the compiled ring under
+        # an armed tracer emits NO spans (hazard); the traced tick drive
+        # emits its slots (clean)
+        pipe_loss = pipelined_loss_fn(
+            embed=model.embed,
+            run_layers=lambda lp, h: model.run_layers(lp, h),
+            head_loss=lambda p, h, t: model.head(p, h, t),
+            num_microbatches=M, virtual_pipeline_size=vpp)
+        rest_specs_p = jax.tree.map(lambda _: P(), rest)
+        compiled_drive = jax.shard_map(
+            pipe_loss, mesh=mesh,
+            in_specs=(rest_specs_p, layer_specs, P(), P()),
+            out_specs=P(), check_vma=False)
+        hz_bad = lint_trace.untimed_schedule_hazards(
+            lambda: jax.make_jaxpr(compiled_drive)(
+                rest, layers_sh, toks, tgt))
+        hz_ok = lint_trace.untimed_schedule_hazards(
+            lambda: traced_pipeline_timeline(
+                mesh, embed=model.embed,
+                run_layers=lambda lp, h: model.run_layers(lp, h),
+                head_loss=lambda p, h, t: model.head(p, h, t),
+                rest_params=rest, layers=layers_sh,
+                layer_specs=layer_specs, batch=toks, targets=tgt,
+                num_microbatches=M, virtual_pipeline_size=vpp, step=1))
+        record["untimed_schedule"] = {
+            "compiled_drive": {k: hz_bad[k]
+                               for k in ("hazard", "drives", "pipe_spans")},
+            "traced_drive": {k: hz_ok[k]
+                             for k in ("hazard", "drives", "pipe_spans")},
+        }
+        checks["untimed_tripwire"] = bool(
+            hz_bad["hazard"] and not hz_ok["hazard"]
+            and hz_ok["pipe_spans"] > 0)
+    except Exception as e:  # noqa: BLE001 - a negative result is a result
+        record["pipeline_error"] = str(e)[:400]
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+    # -- ZeRO / ZeRO-3 phase anatomy (traced two-program steps) ------------
+    for lvl in (2, 3):
+        key = f"zero{lvl}"
+        try:
+            mesh = mesh_lib.make_virtual_mesh(8)
+            cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                            num_attention_heads=4, max_seq_len=16,
+                            hidden_dropout=0.0,
+                            compute_dtype=jnp.bfloat16, remat=False)
+            zmodel = GPTModel(cfg)
+            policy = amp.get_policy("O2")
+            mp_opt = amp.MixedPrecisionOptimizer(
+                FusedAdam(lr=1e-3), policy,
+                zero_axis=mesh_lib.AXIS_DATA, zero_level=lvl)
+            full = amp.cast_params(
+                zmodel.init(jax.random.PRNGKey(0)), policy)
+            zspecs, zparams, zpipe_loss = prepare_pipelined_model(
+                zmodel, full, mesh, num_microbatches=2)
+            zrest_specs = {k: v for k, v in zspecs.items()
+                           if k != "layers"}
+            grad_axes = mesh_lib.get_gradient_reduction_axes()
+            data_spec = P(mesh_lib.AXIS_DATA)
+            if lvl >= 3:
+                z3 = mp_opt.zero3_init(zparams, mesh, zspecs)
+                zparams, opt_state = z3.params, z3.opt_state
+                step = build_zero_train_step(
+                    mp_opt, mesh, None, None, None,
+                    rest_specs=zrest_specs,
+                    layer_specs=zspecs["layers"], grad_axes=grad_axes,
+                    data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA,
+                    zero3=z3, model=zmodel, num_microbatches=2,
+                    traced=True, tracer=tracer)
+            else:
+                opt_state, state_specs = mp_opt.zero_init(
+                    zparams, mesh, zspecs)
+                step = build_zero_train_step(
+                    mp_opt, mesh, zspecs, state_specs, zpipe_loss,
+                    rest_specs=zrest_specs, grad_axes=grad_axes,
+                    data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA,
+                    traced=True, tracer=tracer)
+            ztoks = jax.random.randint(jax.random.PRNGKey(2), (16, 16),
+                                       0, 128)
+            shard = lambda a: jax.device_put(  # noqa: E731
+                a, NamedSharding(mesh, data_spec))
+            ztoks = shard(ztoks)
+            ztgts = shard(jnp.roll(ztoks, -1, axis=-1))
+            n0 = len(tracer.records)
+            for i in range(3):  # window 0 pays compile; 1-2 measure
+                tracer.step = 100 * lvl + i
+                with tracer.span("step", step=100 * lvl + i) as sp:
+                    zparams, opt_state, zloss, _ = step(
+                        zparams, opt_state, ztoks, ztgts)
+                    sp.barrier(zloss)
+            spans = [r for r in tracer.records[n0:]
+                     if r.get("kind") == "span"]
+            windows = []
+            for i in (1, 2):
+                st = 100 * lvl + i
+                wall = next(r["dur_s"] for r in spans
+                            if r["name"] == "step" and r.get("step") == st)
+                grads = next(r for r in spans
+                             if r["name"] == "zero.grads"
+                             and r.get("step") == st)
+                apply_ = next(r for r in spans
+                              if r["name"] == "zero.apply"
+                              and r.get("step") == st)
+                an = tracing.step_anatomy(
+                    wall_s=wall, compute_s=grads["dur_s"],
+                    comm_s=apply_["dur_s"])
+                an["comm_bytes"] = {"grads": grads.get("comm_bytes"),
+                                    "apply": apply_.get("comm_bytes")}
+                windows.append(an)
+            record[key] = {"windows": windows,
+                           "loss": round(float(zloss), 6)}
+            checks[f"{key}_fracs_sum_1"] = all(
+                abs(w["compute_frac"] + w["comm_frac"]
+                    + w["stall_frac"] - 1.0) < 2e-3 for w in windows)
+            # the phase spans must actually cover the step: anything
+            # else means the split lost a phase
+            checks[f"{key}_phases_cover_step"] = all(
+                w["stall_frac"] < 0.3 for w in windows)
+        except Exception as e:  # noqa: BLE001
+            record[f"{key}_error"] = str(e)[:400]
+        finally:
+            mesh_lib.destroy_model_parallel()
+
+    # -- Chrome export round-trip ------------------------------------------
+    try:
+        tracer.close()
+        chrome_path = trace_path + ".chrome.json"
+        tracing.write_chrome_trace(trace_path, chrome_path)
+        with open(chrome_path) as f:
+            trace = json.load(f)
+        ev = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        record["chrome"] = {"path": chrome_path, "events": len(ev)}
+        checks["chrome_export_loadable"] = bool(
+            ev and all(
+                isinstance(e.get("ts"), (int, float))
+                and isinstance(e.get("dur"), (int, float))
+                and e.get("dur") >= 0 and "name" in e and "pid" in e
+                for e in ev))
+    except Exception as e:  # noqa: BLE001
+        record["chrome"] = {"error": str(e)[:300]}
+
+    record["checks"] = {k: bool(v) for k, v in checks.items()}
+    required = ("bubble_within_tolerance", "loss_matches_serial",
+                "untimed_tripwire", "zero2_fracs_sum_1",
+                "zero3_fracs_sum_1", "chrome_export_loadable")
+    record["ok"] = all(record["checks"].get(k) for k in required)
+    print(json.dumps(record))
+    with open(output, "w") as f:
+        json.dump(record, f, indent=1)
+    return 0 if record["ok"] else 1
+
+
 def main():
     # jax<0.5 API renames (shard_map/axis_size): installed only when the
     # harness RUNS as a program, same as gpt_scaling.py
@@ -832,11 +1078,20 @@ def main():
                          "quantized_comm_hazards census, and the executed "
                          "error-feedback microbenchmark; writes "
                          "out/qcomm_evidence.json")
+    ap.add_argument("--timeline", action="store_true",
+                    help="step-anatomy evidence mode (EXECUTES on the "
+                         "8-device CPU virtual mesh): traced vpp tick "
+                         "drive measuring per-rank bubble fraction vs "
+                         "the analytic floor, traced ZeRO/ZeRO-3 phase "
+                         "anatomy, untimed-schedule tripwire, Chrome "
+                         "trace export; writes out/timeline_evidence.json")
     ap.add_argument("--dp", type=int, default=8,
                     help="data-axis size for the --zero census/state table")
     ap.add_argument("--output", default=None)
     args = ap.parse_args()
 
+    if args.timeline:
+        sys.exit(_timeline_main(args))
     if args.qcomm:
         sys.exit(_qcomm_main(args))
     if args.zero3:

@@ -54,6 +54,10 @@ def parse_args():
                         "at 1 B/elem + per-chunk fp32 scales, with an "
                         "error-feedback residual in the sharded state "
                         "(parallel/quantize.py)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write a span trace (apex_tpu.monitor.tracing): "
+                        "one barriered span per step plus a Chrome "
+                        "trace-event export next to PATH")
     args = p.parse_args()
     if args.zero_level is not None:
         args.zero = True
@@ -188,17 +192,42 @@ def main():
 
     if args.steps < 2:
         raise SystemExit("--steps must be >= 2 (step 0 is compile warmup)")
+    tracer = None
+    if args.trace:
+        from apex_tpu.monitor import tracing
+
+        tracer = tracing.arm(args.trace,
+                             meta={"run": "pretrain_bert",
+                                   "zero_level": args.zero_level or 0})
     rng = np.random.default_rng(0)
     t0 = time.perf_counter()
     for i in range(args.steps):
         batch = synthetic_batch(rng, args.batch, args.seq, cfg.vocab_size)
-        params, state, loss, metrics = train_step(params, state, *batch)
+        if tracer is not None:
+            from apex_tpu.monitor.tracing import maybe_span
+
+            tracer.step = i
+            with maybe_span(tracer, "step", step=i) as sp:
+                params, state, loss, metrics = train_step(
+                    params, state, *batch)
+                sp.barrier(loss)
+        else:
+            params, state, loss, metrics = train_step(params, state, *batch)
         if i == 0:
             float(loss)
             t0 = time.perf_counter()
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:4d} mlm+nsp loss {float(loss):.4f} "
                   f"scale {float(metrics['loss_scale']):.0f}")
+    if tracer is not None:
+        from apex_tpu.monitor import tracing
+
+        tracing.disarm()
+        try:
+            tracing.write_chrome_trace(args.trace,
+                                       args.trace + ".chrome.json")
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"chrome export failed: {e}")
     n = max(args.steps - 1, 1)
     dt = (time.perf_counter() - t0) / n
     print(f"{args.batch * args.seq / dt:.0f} tokens/s "

@@ -98,6 +98,13 @@ def parse_args():
                         "(apex_tpu.monitor: wall time, tokens/s, loss, "
                         "grad-norm, loss-scale state, HBM samples); adds "
                         "one loss fetch per step")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write a span trace (apex_tpu.monitor.tracing): "
+                        "per-step spans, ZeRO grads/apply phase spans "
+                        "(two-program step build), a traced pipeline "
+                        "tick drive measuring per-rank bubble fraction "
+                        "(pp>1, tp=1), and a Chrome trace-event export "
+                        "next to PATH (chrome://tracing / Perfetto)")
     args = p.parse_args()
     if args.zero_level is not None:
         args.zero = True
@@ -157,6 +164,15 @@ def main():
     )
     params = tp_mod.shard_params(full, specs, mesh)
 
+    tracer = None
+    if args.trace:
+        from apex_tpu.monitor import tracing
+
+        tracer = tracing.arm(
+            args.trace,
+            meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
+                  "zero_level": args.zero_level or 0})
+
     batch = args.micro_batch * dp * args.num_microbatches
     data_spec = P(mesh_lib.AXIS_DATA)
     rest_specs = {k: v for k, v in all_specs.items() if k != "layers"}
@@ -205,13 +221,15 @@ def main():
                 grad_axes=grad_axes,
                 data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA,
                 zero3=z3, model=model,
-                num_microbatches=args.num_microbatches)
+                num_microbatches=args.num_microbatches,
+                traced=bool(args.trace), tracer=tracer)
         else:
             opt_state, state_specs = mp_opt.zero_init(params, mesh, specs)
             train_step = build_zero_train_step(
                 mp_opt, mesh, specs, state_specs, pipe_loss,
                 rest_specs=rest_specs, grad_axes=grad_axes,
-                data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA)
+                data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA,
+                traced=bool(args.trace), tracer=tracer)
     else:
         opt_state = mp_opt.init(params)
         shard_fn = jax.shard_map(
@@ -293,25 +311,75 @@ def main():
             # spurious compile, and on zeros so no real batch from
             # --data is consumed just for tracing (bench.py's
             # _register_window_costs idiom)
+            from apex_tpu.monitor import comm_accounting
+
             z = shard(jnp.zeros((batch, args.seq), jnp.int32))
-            costs = mfu_lib.traced_step_costs(
-                train_step, params, opt_state, z, z)
+            # the same trace also books collective payload bytes, so the
+            # journal's step-anatomy fields (compute/comm/stall fractions
+            # + overlap, monitor/tracing.py step_anatomy) arm for free
+            with comm_accounting() as acct:
+                costs = mfu_lib.traced_step_costs(
+                    train_step, params, opt_state, z, z)
             journal.set_step_costs(
                 flops_per_token=costs["flops"] / (batch * args.seq),
                 bytes_per_token=costs["bytes"] / (batch * args.seq),
                 method=costs["method"])
+            journal.set_step_comm(acct.total_bytes())
         except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
             print(f"mfu arming failed (journal continues without): {e}")
         train_step = RecompileTracker(journal).wrap(train_step,
                                                     name="train_step")
+
+    if (args.trace and args.pp > 1 and args.tp == 1
+            and (args.zero_level or 0) < 3):
+        # measure the pipeline's per-rank bubble fraction for real: one
+        # tick-by-tick traced drive of the SAME ring (schedules.
+        # traced_pipeline_timeline), spans into the trace file, the
+        # measured-vs-analytic stamp into every journal record
+        try:
+            from apex_tpu.monitor import tracing as tracing_mod
+            from apex_tpu.transformer.pipeline_parallel import (
+                traced_pipeline_timeline,
+            )
+
+            probe_rows = args.micro_batch * args.num_microbatches
+            ptoks = jnp.zeros((probe_rows, args.seq), jnp.int32)
+            _, _, anatomy = traced_pipeline_timeline(
+                mesh, embed=model.embed,
+                run_layers=lambda lp, h: model.run_layers(lp, h),
+                head_loss=lambda p, h, t: model.head(p, h, t),
+                rest_params={k: v for k, v in params.items()
+                             if k != "layers"},
+                layers=params["layers"], layer_specs=specs["layers"],
+                batch=ptoks, targets=ptoks,
+                num_microbatches=args.num_microbatches,
+                tracer=tracer, step=-1)
+            print(f"measured bubble fraction "
+                  f"{anatomy['bubble_fraction']['mean']} "
+                  f"(analytic floor {anatomy['expected_bubble_fraction']})")
+            if journal is not None:
+                journal.set_bubble_fraction(
+                    anatomy["bubble_fraction"]["mean"],
+                    anatomy["expected_bubble_fraction"])
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"bubble probe failed (run continues without): {e}")
 
     t0 = time.perf_counter()
     for i in range(start, start + args.steps):
         toks, tgts = next_batch()
         if journal is not None:
             journal.step_start()
-        params, opt_state, loss, metrics = train_step(
-            params, opt_state, shard(toks), shard(tgts))
+        if tracer is not None:
+            from apex_tpu.monitor.tracing import maybe_span
+
+            tracer.step = i
+            with maybe_span(tracer, "step", step=i) as sp:
+                params, opt_state, loss, metrics = train_step(
+                    params, opt_state, shard(toks), shard(tgts))
+                sp.barrier(loss)
+        else:
+            params, opt_state, loss, metrics = train_step(
+                params, opt_state, shard(toks), shard(tgts))
         if journal is not None:
             # the journal's float(loss) IS the step's execution barrier
             # (tunnel discipline); metrics/scaler fetches ride after it
@@ -329,6 +397,16 @@ def main():
                 args.save_dir, i + 1, {"params": params, "opt": opt_state})
     if journal is not None:
         journal.close()
+    if tracer is not None:
+        from apex_tpu.monitor import tracing as tracing_mod
+
+        tracing_mod.disarm()  # flush + close
+        try:
+            tracing_mod.write_chrome_trace(
+                args.trace, args.trace + ".chrome.json")
+            print(f"chrome trace: {args.trace}.chrome.json")
+        except Exception as e:  # noqa: BLE001
+            print(f"chrome export failed: {e}")
     n_done = max(args.steps - 1, 1)
     dt = (time.perf_counter() - t0) / n_done
     print(f"{batch * args.seq / dt:.0f} tokens/s | mesh: tp={args.tp} pp={args.pp} "

@@ -785,6 +785,40 @@ def test_quantized_comm_on_real_mixed_precision_step():
 # ---------------------------------------------------------------------------
 
 
+def test_untimed_schedule_hazard_flags_spanless_drive():
+    """A pipeline ring drive traced under an armed tracer with no pipe
+    spans is the census-only regression (the step-anatomy tripwire); a
+    span-emitting drive and a drive-free fn pass. The REAL compiled-vs-
+    traced-drive pairing is pinned in tests/test_tracing.py."""
+    import jax
+
+    from apex_tpu.transformer.pipeline_parallel import schedules
+
+    run_stage = lambda lp, h: h * (1.0 + jnp.sum(lp))  # noqa: E731
+    layers_l = jnp.ones((4, 2, 2))
+    h_mb = jnp.ones((4, 3, 5))
+    ring = jax.vmap(
+        lambda ll, hm: schedules._pipeline_ring(run_stage, ll, hm, "i"),
+        axis_name="i")
+
+    bad = trace.untimed_schedule_hazards(
+        lambda: jax.make_jaxpr(ring)(layers_l, h_mb))
+    assert bad["hazard"] and bad["drives"] == 1 and bad["pipe_spans"] == 0
+    assert bad["findings"][0]["rule"] == "untimed-schedule"
+
+    def timed():
+        from apex_tpu.monitor import tracing
+
+        jax.make_jaxpr(ring)(layers_l, h_mb)
+        tracing.get_tracer().record("fwd", dur_s=0.01, cat="pipe", rank=0)
+
+    ok = trace.untimed_schedule_hazards(timed)
+    assert not ok["hazard"] and ok["pipe_spans"] == 1
+
+    none = trace.untimed_schedule_hazards(lambda: jnp.ones(()) * 2)
+    assert not none["hazard"] and none["drives"] == 0
+
+
 def test_recompile_hazards_name_offending_leaves():
     haz = trace.recompile_hazards(
         {"opt": {"loss_scale": 2.0 ** 16}, "x": jnp.ones((2,), jnp.float32)},

@@ -242,6 +242,66 @@ def test_compare_ok_and_regressed():
     assert report.compare(a, c, threshold=0.05)["ok"]
 
 
+def test_analyze_timeline_section():
+    recs = [_step(i, 100.0 + i, bubble_fraction=0.27,
+                  bubble_fraction_expected=0.25, overlap_fraction=0.4,
+                  compute_frac=0.7, comm_frac=0.2, stall_frac=0.1)
+            for i in range(4)]
+    tl = report.analyze(recs)["timeline"]
+    assert tl["bubble_fraction"] == {"last": 0.27, "p50": 0.27}
+    assert tl["bubble_fraction_expected"] == 0.25
+    assert tl["overlap_fraction"]["p50"] == 0.4
+    assert tl["compute_frac_mean"] == 0.7
+    assert "timeline" not in report.analyze(
+        [_step(i, 100.0 + i) for i in range(4)])
+
+
+def test_compare_bubble_threshold_gate():
+    a = [_step(i, 100.0 + i, bubble_fraction=0.20) for i in range(6)]
+    worse = [_step(i, 100.0 + i, bubble_fraction=0.30) for i in range(6)]
+    res = report.compare(a, worse, bubble_threshold=0.10)
+    assert "bubble_fraction_p50" in res["regressed"]
+    # within tolerance (threshold 0.10 + the 0.01 abs slack): ok
+    near = [_step(i, 100.0 + i, bubble_fraction=0.225) for i in range(6)]
+    assert report.compare(a, near, bubble_threshold=0.10)["ok"]
+    # bubble_threshold defaults to --threshold when unset
+    res2 = report.compare(a, worse, threshold=0.05)
+    assert "bubble_fraction_p50" in res2["regressed"]
+    # a LOWER bubble (the schedule-improvement direction) never regresses
+    better = [_step(i, 100.0 + i, bubble_fraction=0.05) for i in range(6)]
+    assert report.compare(a, better)["ok"]
+    # CLI surface
+    import os
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    try:
+        pa, pb = os.path.join(d, "a.jsonl"), os.path.join(d, "b.jsonl")
+        for path, rows in ((pa, a), (pb, worse)):
+            with open(path, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+        assert report.main(
+            ["compare", pa, pb, "--bubble-threshold", "0.1", "--json"]) == 1
+        assert report.main(
+            ["compare", pa, pb, "--bubble-threshold", "0.6", "--json"]) == 0
+    finally:
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_shared_tolerance_predicates():
+    """The one predicate pair every fractional gate shares (satellite:
+    no copy-pasted tolerance handling per metric)."""
+    drop = report.must_not_drop(0.05)
+    assert drop(100.0, 94.9) and not drop(100.0, 95.1)
+    grow = report.must_not_grow(0.05)
+    assert grow(100.0, 105.1) and not grow(100.0, 104.9)
+    slack = report.must_not_grow(0.10, slack=0.01)
+    assert not slack(0.0, 0.009) and slack(0.0, 0.011)
+
+
 def test_compare_overflow_and_hbm_and_nonfinite_regressions():
     a = [_step(i, 100.0 + i, hbm={"live_bytes": 1000}) for i in range(6)]
     b = [dict(_step(i, 100.0 + i, hbm={"live_bytes": 1000 + 50_000_000 * i}),
