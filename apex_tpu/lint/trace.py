@@ -49,6 +49,12 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   quantizes grads with no error-feedback residual leaf in the optimizer
   state -- bias then accumulates instead of telescoping.
 
+- ``decode-recompile``  (:func:`decode_recompile_hazards`) -- a serving
+  decode step whose jit signature DRIFTS across ticks (growing per-request
+  KV shapes, python-int position/tick leaks): one recompile per generated
+  token, the latency cliff the paged cache + fixed slot arrays exist to
+  prevent (apex_tpu/serve/engine.py).
+
 All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
 device work) and return plain dicts/lists of findings shaped like engine
 1's (rule/message), so CLI and journal consumers render them uniformly.
@@ -805,6 +811,75 @@ def recompile_hazards(*args, **kwargs) -> List[Dict[str, Any]]:
                                f"cache; build it with an explicit dtype",
                 })
     return findings
+
+
+def decode_recompile_hazards(step_args_fn, ticks: int = 3) -> Dict[str, Any]:
+    """Verify a serving decode step's jit signature is SHAPE-STABLE across
+    ticks — the decode-recompile tripwire.
+
+    ``step_args_fn(t)`` must return the exact argument pytree the jitted
+    decode step would receive at tick ``t`` (``apex_tpu.serve.Engine.
+    decode_args``). The engine's whole design contract is that every tick
+    compiles once: a per-request KV tensor that grows with the sequence, a
+    python-int position/tick, or a weak-typed leaf makes XLA recompile PER
+    TOKEN — the latency cliff this scanner names before the first tick
+    runs (``monitor.diagnose.RecompileTracker`` counts it after the fact).
+
+    Findings: ``decode-shape-churn`` (a leaf's shape/dtype/weak-type
+    differs between ticks — e.g. contiguous per-request KV instead of the
+    paged pool), ``decode-structure-churn`` (the pytree itself changes),
+    plus tick-0 :func:`recompile_hazards` findings (python scalars /
+    weak types in the signature). Host-side only; nothing is compiled.
+
+    Returns ``{hazard, findings, ticks, leaves}``.
+    """
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    def signature(tree):
+        leaves, _ = tree_flatten_with_path((tree,))
+        out = []
+        for path, leaf in leaves:
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+            weak = bool(getattr(leaf, "weak_type", False))
+            out.append((keystr(path), shape, dtype, weak))
+        return out
+
+    findings: List[Dict[str, Any]] = []
+    base = None
+    for t in range(int(ticks)):
+        args = step_args_fn(t)
+        if t == 0:
+            findings.extend(recompile_hazards(args))
+            base = signature(args)
+            continue
+        sig = signature(args)
+        if [s[0] for s in sig] != [s[0] for s in base]:
+            findings.append({
+                "rule": "decode-structure-churn",
+                "message": (
+                    f"decode args pytree STRUCTURE changed between tick 0 "
+                    f"and tick {t} ({len(base)} vs {len(sig)} leaves) -- "
+                    f"every tick must ship the same tree (fixed max_batch "
+                    f"slot arrays, the paged pool; serve/engine.py)"),
+            })
+            continue
+        for (where, shape, dtype, weak), (_, s0, d0, w0) in zip(sig, base):
+            if (shape, dtype, weak) == (s0, d0, w0):
+                continue
+            findings.append({
+                "rule": "decode-shape-churn",
+                "where": where,
+                "message": (
+                    f"decode arg {where} changed from {s0}/{d0}"
+                    f"{'/weak' if w0 else ''} at tick 0 to {shape}/{dtype}"
+                    f"{'/weak' if weak else ''} at tick {t} -- a fresh jit "
+                    f"signature (and a recompile) per tick; per-request KV "
+                    f"must live in the fixed paged pool and positions must "
+                    f"be committed int32 arrays (serve/cache.py)"),
+            })
+    return {"hazard": bool(findings), "findings": findings,
+            "ticks": int(ticks), "leaves": len(base or [])}
 
 
 # ---------------------------------------------------------------------------

@@ -64,6 +64,27 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     tokens the learned table alone is ~3.75 GB of params+optimizer state.
     Beyond-reference capability (the reference's GPT is learned-position
     only, standalone_gpt.py embeddings)."""
+    return _rope_rotate(x, positions, theta, batched=False)
+
+
+def apply_rope_at(x: jax.Array, positions: jax.Array,
+                  theta: float = 10000.0) -> jax.Array:
+    """:func:`apply_rope` with PER-SEQUENCE positions: ``x`` is
+    ``(b, nh, s, d)`` and ``positions`` is ``(b, s)`` — the decode-tick
+    form, where every serving slot sits at its own context position. One
+    shared angle/rotation body (:func:`_rope_rotate`), so a decoded
+    token's rotation matches the training forward's bit for bit at equal
+    position by construction."""
+    return _rope_rotate(x, positions, theta, batched=True)
+
+
+def _rope_rotate(x, positions, theta, *, batched):
+    """Shared rope body: angles from the K-split reduction, then the
+    split-half rotation. ``batched=False``: ``positions`` is ``(s,)``
+    shared across the batch; ``True``: ``(b, s)`` per sequence (the
+    angle tensor gains a leading batch dim, broadcast over heads).
+    Per-element the two forms run the identical f32 op sequence — the
+    serve equivalence gate rests on that."""
     import numpy as np
 
     d = x.shape[-1]
@@ -78,11 +99,11 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     inv64 = theta ** (-np.arange(half, dtype=np.float64) * 2.0 / d)
     kmod = jnp.asarray(np.mod(K * inv64, 2 * np.pi), jnp.float32)
     inv_freq = jnp.asarray(inv64, jnp.float32)
-    a = (positions // K).astype(jnp.float32)[:, None]
-    r = (positions % K).astype(jnp.float32)[:, None]
-    ang = a * kmod + r * inv_freq  # (s, half)
-    cos = jnp.cos(ang)
-    sin = jnp.sin(ang)
+    a = (positions // K).astype(jnp.float32)[..., None]  # (s, 1) | (b, s, 1)
+    r = (positions % K).astype(jnp.float32)[..., None]
+    ang = a * kmod + r * inv_freq                        # (..., s, half)
+    cos = jnp.cos(ang)[:, None] if batched else jnp.cos(ang)  # + head bcast
+    sin = jnp.sin(ang)[:, None] if batched else jnp.sin(ang)
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate(
@@ -300,30 +321,53 @@ class TransformerBase:
             key = tp.model_parallel_key(key, c.axis)
         return inverted_dropout(x, key, c.hidden_dropout)
 
-    def _attention(self, p: Params, h: jax.Array, bias=None) -> jax.Array:
+    def _qkv_heads(self, p_qkv: Params, h: jax.Array,
+                   positions: Optional[jax.Array] = None):
+        """``(q, k, v)`` head tensors ``(b, n_local, s, d)`` from the fused
+        QKV projection — the shared front half of :meth:`_attention`, also
+        driven standalone by the serving prefill/decode paths (which need
+        the raw k/v heads for the paged cache). ``positions`` overrides the
+        rope positions with explicit PER-SEQUENCE ``(b, s)`` values (decode:
+        each slot sits at its own context position); default is the
+        training-forward :meth:`_token_positions`."""
         c = self.cfg
         b = h.shape[0]
+        qkv = self.qkv.apply(p_qkv, h)  # (b, s, 3*H/tp)
+        # under sequence parallelism h arrives (b, s/tp, H) and the
+        # column layer's pre-GEMM all-gather restores the full
+        # (context-local) sequence — read s from the GATHERED tensor
+        s = qkv.shape[1]
+        # (heads, 3, head_dim) layout: a TP shard holds whole heads — the
+        # layout contract of ParallelAttention (standalone_gpt.py:560-640).
+        n_local = qkv.shape[-1] // (3 * c.head_dim)
+        qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
+        if getattr(c, "position_embedding", "learned") == "rope":
+            theta = getattr(c, "rope_theta", 10000.0)
+            if positions is None:
+                pos = self._token_positions(s)
+                q = apply_rope(q, pos, theta)
+                k = apply_rope(k, pos, theta)
+            else:
+                q = apply_rope_at(q, positions, theta)
+                k = apply_rope_at(k, positions, theta)
+        return q, k, v
+
+    def _attn_out(self, p: Params, attn: jax.Array) -> jax.Array:
+        """Head-merge + output projection — the shared back half of
+        :meth:`_attention` (also the serving decode epilogue)."""
+        b, n_local, s, _ = attn.shape
+        attn = attn.transpose(0, 2, 1, 3).reshape(
+            b, s, n_local * self.cfg.head_dim)
+        return self.proj.apply(p["proj"], attn)
+
+    def _attention(self, p: Params, h: jax.Array, bias=None) -> jax.Array:
         # named scope = the per-op attribution key of pyprof.report (the
         # NVTX range the reference's nvmarker.py pushes around each module)
         with jax.named_scope("attention"):
-            qkv = self.qkv.apply(p["qkv"], h)  # (b, s, 3*H/tp)
-            # under sequence parallelism h arrives (b, s/tp, H) and the
-            # column layer's pre-GEMM all-gather restores the full
-            # (context-local) sequence — read s from the GATHERED tensor
-            s = qkv.shape[1]
-            # (heads, 3, head_dim) layout: a TP shard holds whole heads — the
-            # layout contract of ParallelAttention (standalone_gpt.py:560-640).
-            n_local = qkv.shape[-1] // (3 * c.head_dim)
-            qkv = qkv.reshape(b, s, n_local, 3, c.head_dim).transpose(0, 2, 3, 1, 4)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, nh, s, d)
-            if getattr(c, "position_embedding", "learned") == "rope":
-                pos = self._token_positions(s)
-                theta = getattr(c, "rope_theta", 10000.0)
-                q = apply_rope(q, pos, theta)
-                k = apply_rope(k, pos, theta)
+            q, k, v = self._qkv_heads(p["qkv"], h)
             attn = self._attend(q, k, v, bias)
-            attn = attn.transpose(0, 2, 1, 3).reshape(b, s, n_local * c.head_dim)
-            return self.proj.apply(p["proj"], attn)
+            return self._attn_out(p, attn)
 
     def _seq_shard_start(self, s_local: int):
         """Global position of this shard's first token for a tensor whose

@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models._transformer import TransformerBase
@@ -339,6 +340,118 @@ class GPTModel(TransformerBase):
             # (a scalar added uniformly keeps the mean-loss contract)
             out = out + self.aux_to_loss(aux).astype(out.dtype)
         return out
+
+    # -- serving drives (apex_tpu/serve/engine.py) --------------------------
+    # Inference-only siblings of embed/run_layers/head: same parameter tree,
+    # same per-token math (so greedy decode bit-matches the training
+    # forward's argmax — the serve equivalence gate), but threaded through
+    # the paged KV cache instead of recomputing the whole context per token.
+
+    def check_servable(self) -> None:
+        """Serving composes with TP and attention_window; the modes that
+        reshape the sequence or route tokens (CP rings, Megatron SP, MoE)
+        have no decode-cache story yet — fail loudly at engine build."""
+        c = self.cfg
+        if c.moe_num_experts is not None:
+            raise ValueError("serving does not support MoE FFNs yet")
+        if getattr(c, "context_axis", None) is not None:
+            raise ValueError(
+                "serving does not support context parallelism: the paged "
+                "cache is per-slot, not ring-sharded — run decode with "
+                "context_axis=None")
+        if self._sp:
+            raise ValueError(
+                "serving does not support sequence_parallel=True: decode "
+                "works on single-token sequences that cannot shard s/tp "
+                "ways — build the serve model with sequence_parallel=False")
+
+    def embed_at(self, params: Params, tokens: jax.Array,
+                 positions: jax.Array) -> jax.Array:
+        """:meth:`embed` at EXPLICIT per-slot positions ``(b, s)`` — at a
+        decode tick every slot's new token sits at its own context
+        position, so the training method's ``[0, s)`` slice cannot serve.
+        Same math (embedding collective + position-row add) at equal
+        positions."""
+        c = self.cfg
+        with jax.named_scope("embed"):
+            h = self.embedding.apply(params["embedding"], tokens)
+            if c.position_embedding == "learned":
+                h = h + jnp.take(params["position"], positions, axis=0)
+            return h.astype(c.compute_dtype)
+
+    def serve_layers_prefill(self, layers: Params, h: jax.Array):
+        """Run the layer stack over a PROMPT, collecting every layer's k/v
+        head tensors for the cache fill. Returns ``(h, k, v)`` with k/v
+        shaped ``(num_layers, b, n_local_heads, s, head_dim)``. Attention
+        is the training `_attend` (causal + ``attention_window``), so
+        prefill hidden states match the training forward exactly."""
+
+        def body(h, p):
+            x = self._ln(p["ln1"], h)
+            q, k, v = self._qkv_heads(p["qkv"], x)
+            h = h + self._attn_out(p, self._attend(q, k, v, None))
+            h = h + self._mlp(p, self._ln(p["ln2"], h))
+            return h, (k, v)
+
+        h, (ks, vs) = lax.scan(body, h, layers)
+        return h, ks, vs
+
+    def serve_layers_decode(self, layers: Params, h: jax.Array,
+                            k_pages: jax.Array, v_pages: jax.Array,
+                            block_tables: jax.Array, write_flat: jax.Array,
+                            attend_lengths: jax.Array,
+                            positions: jax.Array):
+        """One decode tick through the layer stack: for each layer, write
+        the new token's k/v heads into the paged cache (``write_flat``:
+        per-slot flat row index into the ``(num_blocks*block, kv_heads,
+        head_dim)`` view — the engine owns the page arithmetic; idle slots
+        point at the reserved null page), then flash-decode the token's
+        query over the pages. ``h`` is ``(b, 1, hidden)``; the caches are
+        layer-stacked ``(L, num_blocks, block, kv_heads, head_dim)`` and
+        scan ys rebuild them updated. ``attend_lengths`` includes the token
+        just written (0 = idle slot, output exactly 0)."""
+        from apex_tpu.ops.flash_decode import flash_decode
+
+        c = self.cfg
+
+        def body(h, xs):
+            p, kp, vp = xs
+            n_blocks, blk = kp.shape[0], kp.shape[1]
+            flat_shape = (n_blocks * blk,) + kp.shape[2:]
+            x = self._ln(p["ln1"], h)
+            q, k, v = self._qkv_heads(p["qkv"], x,
+                                      positions=positions[:, None])
+            kp = kp.reshape(flat_shape).at[write_flat].set(
+                k[:, :, 0, :].astype(kp.dtype)).reshape(kp.shape)
+            vp = vp.reshape(flat_shape).at[write_flat].set(
+                v[:, :, 0, :].astype(vp.dtype)).reshape(vp.shape)
+            attn = flash_decode(
+                q[:, :, 0, :], kp, vp, block_tables, attend_lengths,
+                window=c.attention_window, impl=c.attention_impl)
+            h = h + self._attn_out(p, attn[:, :, None, :])
+            h = h + self._mlp(p, self._ln(p["ln2"], h))
+            return h, (kp, vp)
+
+        h, (kps, vps) = lax.scan(body, h, (layers, k_pages, v_pages))
+        return h, kps, vps
+
+    def serve_head(self, params: Params, h: jax.Array) -> jax.Array:
+        """Final LN + tied LM head returning FULL-vocab logits on every
+        rank: under TP the vocab-sharded logits all-gather over the model
+        axis (the mappings.py conjugate), so argmax/sampling is one
+        consistent decision everywhere — the serving replacement for the
+        training head's sharded-logit + vocab-parallel-CE pair."""
+        c = self.cfg
+        with jax.named_scope("head"):
+            x = self._ln(params["ln_f"], h)
+            wte = params["embedding"]["embedding"].astype(x.dtype)  # (V/tp, H)
+            if c.axis is not None:
+                x = tp.copy_to_tensor_model_parallel_region(x, c.axis)
+            logits = jnp.einsum("bsh,vh->bsv", x, wte)
+            if c.axis is not None:
+                logits = tp.gather_from_tensor_model_parallel_region(
+                    logits, c.axis)
+            return logits
 
     def loss(self, params, tokens, targets, dropout_key=None,
              layer_chunk_meta=None) -> jax.Array:

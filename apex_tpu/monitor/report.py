@@ -59,6 +59,17 @@ def _dist(vals: List[float]) -> Dict[str, float]:
             "min": round(s[0], 3), "max": round(s[-1], 3), "n": len(s)}
 
 
+def _dist_tail(vals: List[float]) -> Dict[str, float]:
+    """:func:`_dist` plus the p99 tail — latency-shaped metrics (serving
+    TTFT/ITL), where the tail IS the product claim."""
+    s = sorted(v for v in vals if v is not None)
+    if not s:
+        return {}
+    out = _dist(vals)
+    out["p99"] = round(_percentile(s, 0.99), 3)
+    return out
+
+
 def _lstsq_slope(ys: List[float]) -> float:
     """Least-squares slope of ys over their indices (trend per record)."""
     n = len(ys)
@@ -290,6 +301,39 @@ def analyze(
     if pb:
         out["param_bytes"] = {"last": int(pb[-1]), "peak": int(max(pb))}
 
+    # serving rollup (kind="request" records from apex_tpu.serve.Engine,
+    # plus the queue/occupancy fields its decode ticks stamp on step
+    # records): request latency in MILLISECONDS (journals carry seconds;
+    # the 3-decimal rounding would erase sub-ms off-TPU latencies) with
+    # the p99 tail — the serving product claim — and tokens/s/user from
+    # each request's end-to-end time
+    reqs = [r for r in records if r.get("kind") == "request"]
+    if reqs:
+        sv: Dict[str, Any] = {"requests": len(reqs)}
+        ttft = [1e3 * r["ttft_s"] for r in reqs
+                if isinstance(r.get("ttft_s"), (int, float))]
+        itl = [1e3 * v for r in reqs for v in (r.get("itl_s") or [])
+               if isinstance(v, (int, float))]
+        if ttft:
+            sv["ttft_ms"] = _dist_tail(ttft)
+        if itl:
+            sv["itl_ms"] = _dist_tail(itl)
+        tps_user = [r["new_tokens"] / r["e2e_s"] for r in reqs
+                    if isinstance(r.get("e2e_s"), (int, float))
+                    and r["e2e_s"] > 0
+                    and isinstance(r.get("new_tokens"), (int, float))]
+        if tps_user:
+            sv["tokens_per_sec_per_user"] = _dist(tps_user)
+        qd = [r["queue_depth"] for r in steps
+              if isinstance(r.get("queue_depth"), (int, float))]
+        occ = [r["slot_occupancy"] for r in steps
+               if isinstance(r.get("slot_occupancy"), (int, float))]
+        if qd:
+            sv["queue_depth"] = _dist(qd)
+        if occ:
+            sv["slot_occupancy"] = _dist(occ)
+        out["serving"] = sv
+
     # overflow / forensics / recompile rollups
     overflows = [r["overflows"] for r in steps
                  if isinstance(r.get("overflows"), (int, float))]
@@ -399,6 +443,23 @@ def render(analysis: Dict[str, Any], file=None) -> None:
     if pb:
         p(f"params: {pb['last'] / 1e6:.1f} MB/rank "
           f"(peak {pb['peak'] / 1e6:.1f} MB)")
+    sv = analysis.get("serving")
+    if sv:
+        parts = [f"{sv['requests']} request(s)"]
+        if sv.get("ttft_ms"):
+            parts.append(f"ttft p50 {sv['ttft_ms']['p50']}ms "
+                         f"p99 {sv['ttft_ms']['p99']}ms")
+        if sv.get("itl_ms"):
+            parts.append(f"itl p50 {sv['itl_ms']['p50']}ms "
+                         f"p99 {sv['itl_ms']['p99']}ms")
+        if sv.get("tokens_per_sec_per_user"):
+            parts.append(
+                f"tok/s/user p50 {sv['tokens_per_sec_per_user']['p50']}")
+        if sv.get("queue_depth"):
+            parts.append(f"queue p50 {sv['queue_depth']['p50']}")
+        if sv.get("slot_occupancy"):
+            parts.append(f"occupancy p50 {sv['slot_occupancy']['p50']}")
+        p("serving: " + "; ".join(parts))
     p(f"overflows: {analysis.get('overflows', 0)}")
     fo = analysis.get("forensics")
     if fo:
@@ -461,6 +522,12 @@ def compare(
     learning progress given back" — the machine gate for paired
     fp32-wire vs quantized-wire training runs (the quantized-collectives
     convergence bar, parallel/quantize.py).
+
+    Serving journals (``kind="request"`` records from ``apex_tpu.serve``)
+    gate symmetrically: B must still serve requests when A did, TTFT/ITL
+    p50 must not grow past ``threshold`` (+0.05 ms timer-noise slack), and
+    per-user tokens/s must not drop — the latency-shaped regression gate
+    ISSUE 10's satellite adds.
 
     ``bubble_threshold`` tunes the pipeline bubble-fraction gate
     independently of ``threshold`` (it defaults to ``threshold`` when
@@ -554,6 +621,29 @@ def compare(
           worse=must_not_grow(
               threshold if bubble_threshold is None else bubble_threshold,
               slack=0.01))
+    # serving latency gates (kind="request" journals from the serve
+    # engine): TTFT/ITL p50 must not GROW past the threshold — the same
+    # machine gate training throughput gets, pointed at the latency-shaped
+    # metrics (lower is better, so the growth predicate). The 0.05 ms
+    # absolute slack keeps tiny off-TPU runs from gating on timer noise.
+    sva = ra.get("serving") or {}
+    svb = rb.get("serving") or {}
+    # a candidate that served NOTHING has no "serving" section at all —
+    # default its count to 0 (not None, which would skip the check and
+    # sail a crashed candidate through green) whenever A served requests
+    check("serve_requests", sva.get("requests"),
+          svb.get("requests", 0) if sva.get("requests") else
+          svb.get("requests"),
+          worse=lambda va, vb: va > 0 and vb == 0)
+    for key in ("ttft_ms", "itl_ms"):
+        check(f"{key}_p50",
+              (sva.get(key) or {}).get("p50"),
+              (svb.get(key) or {}).get("p50"),
+              worse=must_not_grow(threshold, slack=0.05))
+    check("tokens_per_sec_per_user_p50",
+          (sva.get("tokens_per_sec_per_user") or {}).get("p50"),
+          (svb.get("tokens_per_sec_per_user") or {}).get("p50"),
+          worse=must_not_drop(threshold))
     regressed = [c["check"] for c in checks if c["regressed"]]
     return {"threshold": threshold, "checks": checks,
             "regressed": regressed, "ok": not regressed,

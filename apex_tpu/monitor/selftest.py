@@ -41,6 +41,14 @@ that the monitor pieces stay importable and functional:
    untimed-schedule tripwire flags a pipeline drive that emits no spans
    under an armed tracer (a span-emitting drive passes).
 
+10. serve: the inference engine (apex_tpu.serve) greedily decodes two
+    continuous-batched requests through the paged KV cache and the
+    tokens match the full-context forward's argmax at every position;
+    pages and slots all release; per-request journal records roll up
+    into report's serving section; and the decode-recompile tripwire
+    passes the engine's real tick argument stream while flagging a
+    growing per-request KV tensor.
+
 Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
 proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
 
@@ -527,6 +535,69 @@ def _check_tracing() -> dict:
             "chrome_events": len(ev)}
 
 
+def _check_serve() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.lint.trace import decode_recompile_hazards
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.monitor.journal import MetricsJournal
+    from apex_tpu.serve import Engine, Request, ServeConfig
+
+    # engine smoke (serial build — runs on any device count; the TP-sharded
+    # build rides dryrun_multichip's serve config + tier-1): greedy decode
+    # through the paged cache must reproduce the full-context forward's
+    # argmax at every generated position — the serve equivalence gate
+    cfg = GPTConfig(vocab_size=41, hidden_size=16, num_layers=1,
+                    num_attention_heads=2, max_seq_len=32,
+                    hidden_dropout=0.0, axis=None,
+                    compute_dtype=jnp.float32, remat=False)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = Engine(model, params,
+                 ServeConfig(max_batch=2, max_seq=24, block_size=8))
+    fd, path = tempfile.mkstemp(prefix="apex_tpu_serve_", suffix=".jsonl")
+    os.close(fd)
+    try:
+        with MetricsJournal(path) as j:
+            res = eng.run([Request(prompt=[3, 1, 4, 1, 5], max_new_tokens=4,
+                                   request_id="a"),
+                           Request(prompt=[2, 7], max_new_tokens=3,
+                                   request_id="b")], journal=j)
+        assert set(res) == {"a", "b"}, res
+        for req in res.values():
+            seq = list(req.prompt) + req.tokens
+            ref = jnp.argmax(
+                model.apply(params, jnp.asarray([seq], jnp.int32))[0], -1)
+            want = [int(v) for v in np.asarray(ref)[len(req.prompt) - 1:-1]]
+            assert req.tokens == want, (req.request_id, req.tokens, want)
+        # continuous batching released every page and slot
+        assert eng.allocator.used == 0 and eng.batcher.idle
+        rows = MetricsJournal.read(path)
+        kinds = [r["kind"] for r in rows]
+        assert kinds.count("request") == 2 and "step" in kinds, kinds
+        from apex_tpu.monitor import report as report_mod
+
+        sv = report_mod.analyze(rows).get("serving")
+        assert sv and sv["requests"] == 2 and "ttft_ms" in sv, sv
+    finally:
+        os.unlink(path)
+
+    # the decode-recompile tripwire: the engine's REAL tick argument
+    # stream is shape-stable; a growing per-request KV tensor is flagged
+    clean = decode_recompile_hazards(eng.decode_args, ticks=3)
+    assert not clean["hazard"], clean["findings"][:2]
+
+    grow = decode_recompile_hazards(
+        lambda t: (jnp.ones((1, 2, t + 1, 4), jnp.float32),
+                   jnp.zeros((2,), jnp.int32)), ticks=2)
+    assert grow["hazard"], grow
+    assert grow["findings"][0]["rule"] == "decode-shape-churn", grow
+    return {"ok": True, "requests": len(res),
+            "decode_leaves": clean["leaves"]}
+
+
 def run() -> dict:
     """In-process smoke (no platform mutation — safe under any backend)."""
     results = {}
@@ -538,7 +609,8 @@ def run() -> dict:
                      ("diagnose", _check_diagnose),
                      ("report", _check_report),
                      ("lint", _check_lint),
-                     ("tracing", _check_tracing)):
+                     ("tracing", _check_tracing),
+                     ("serve", _check_serve)):
         try:
             results[name] = fn()
         except Exception as e:  # noqa: BLE001 - report, don't crash the gate

@@ -22,6 +22,10 @@ from apex_tpu.ops.layer_norm import (  # noqa: F401
     rms_norm as rms_norm_kernel,
     rms_norm_reference,
 )
+from apex_tpu.ops.flash_decode import (  # noqa: F401
+    flash_decode,
+    paged_attention_reference,
+)
 from apex_tpu.ops.softmax import (  # noqa: F401
     scaled_masked_softmax,
     scaled_masked_softmax_reference,

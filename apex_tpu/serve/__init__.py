@@ -1,0 +1,34 @@
+"""apex_tpu.serve — inference serving engine (paged KV cache, flash-decode,
+continuous batching).
+
+No reference-file citation: NVIDIA Apex is a training-acceleration library
+with no serving layer (SURVEY.md §2) — this package is the decode-path
+extension the ROADMAP's "millions of users, heavy traffic" north star needs
+(item 3), grounded in the operation-fusion framing of PAPERS.md.
+
+Pieces:
+
+- :mod:`.cache`     — fixed-size KV pages in a preallocated pool + the
+  host-side :class:`BlockAllocator` (per-request KV never recompiles or
+  lane-pads; see the layout note there and PERF_NOTES r11);
+- :mod:`.scheduler` — :class:`ContinuousBatcher`: FIFO request queue over a
+  fixed slot array, admission each tick, slot reuse after retirement;
+- :mod:`.sampler`   — greedy + temperature/top-k sampling with per-slot
+  PRNG keys;
+- :mod:`.engine`    — :class:`Engine`: two jitted shape-stable programs
+  (prefill, decode) over ``max_batch`` slots, TP-sharded via ``shard_map``
+  + the mappings.py conjugates, request-level journaling through
+  ``monitor.MetricsJournal``.
+"""
+
+from apex_tpu.serve.cache import (  # noqa: F401
+    BlockAllocator,
+    CacheOutOfBlocks,
+    KVCacheConfig,
+    NULL_BLOCK,
+    init_kv_cache,
+    kv_cache_spec,
+)
+from apex_tpu.serve.engine import Engine, ServeConfig  # noqa: F401
+from apex_tpu.serve.sampler import sample_tokens  # noqa: F401
+from apex_tpu.serve.scheduler import ContinuousBatcher, Request  # noqa: F401

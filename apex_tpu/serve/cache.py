@@ -1,0 +1,145 @@
+"""Paged KV cache: preallocated page pools + a host-side block allocator.
+
+No reference-file citation: NVIDIA Apex has no serving layer — this is the
+vLLM-style paged-KV design (fixed-size blocks in a preallocated pool,
+per-sequence block tables) rebuilt TPU-native for the serve engine.
+
+Why pages (the decode-recompile gotcha, CLAUDE.md): a per-request contiguous
+KV buffer either grows with the sequence (a fresh jit signature — and a full
+recompile — per token) or preallocates ``max_seq`` per request (O(max_batch ·
+max_seq) HBM held even for short prompts). A fixed pool of ``(block, kv_heads,
+head_dim)`` pages addressed through an int32 block table keeps every decode
+tick's signature identical and bounds HBM by TOTAL tokens resident, not by
+worst-case per-request length.
+
+Layout (the T(8,128) reasoning, PERF_NOTES r11): pages put ``head_dim``
+MINOR — the 128-lane vreg dim — and the block size second-minor (a multiple
+of 8 sublanes), so a page tiles exactly like the training kernels' operands:
+d=128 pages are pad-free, d=32 pays the same 4x lane tax training already
+pays, and nothing ever takes the 128x ``(.., 1)`` column tax. The pool is
+layer-stacked ``(L, num_blocks, block, kv_heads, head_dim)`` with ONE block
+table shared by all layers (block ids are allocated per sequence range, each
+layer storing its own pages at the same ids).
+
+Block 0 is the reserved NULL page: idle slots and masked scatter lanes write
+there, and table slots beyond a sequence's allocation point there so the
+sequential decode grid always fetches a valid page (flash_decode masks those
+trips by length). The allocator never hands it out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+#: the reserved scratch page every table defaults to (never allocated)
+NULL_BLOCK = 0
+
+
+class CacheOutOfBlocks(RuntimeError):
+    """The page pool is exhausted — admission must wait for retirements."""
+
+
+class BlockAllocator:
+    """Free-list allocator over the page pool (host-side, O(1) alloc/free).
+
+    Invariants (unit-tested): block 0 is never handed out; a block is never
+    handed out twice without an intervening free; freeing a free (or
+    out-of-range, or null) block raises. Freed blocks are reusable
+    immediately — the pool cannot fragment (every block is one fixed-size
+    page; "fragmentation" is bounded to internal waste within a sequence's
+    last partial page).
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (one null page + one usable), "
+                f"got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # LIFO free list: recently-freed (likely cache-warm) pages reused first
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._allocated = [False] * self.num_blocks
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def used(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise CacheOutOfBlocks(
+                f"page pool exhausted ({self.num_blocks - 1} usable blocks)")
+        b = self._free.pop()
+        self._allocated[b] = True
+        return b
+
+    def alloc_many(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise CacheOutOfBlocks(
+                f"need {n} blocks, {len(self._free)} available")
+        return [self.alloc() for _ in range(n)]
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            b = int(b)
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"block {b} out of range (null page is "
+                                 f"never freed)")
+            if not self._allocated[b]:
+                raise ValueError(f"double free of block {b}")
+            self._allocated[b] = False
+            self._free.append(b)
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Pages needed to hold ``n_tokens`` (ceil division)."""
+    return -(-int(n_tokens) // int(block_size))
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Page-pool geometry. ``num_blocks`` INCLUDES the null page."""
+
+    num_layers: int
+    kv_heads: int
+    head_dim: int
+    block_size: int = 16
+    num_blocks: int = 64
+    dtype: Any = None  # resolved by init_kv_cache (model compute dtype)
+
+    def __post_init__(self):
+        if self.block_size % 8:
+            raise ValueError(
+                f"block_size must be a multiple of 8 (the sublane tile; "
+                f"flash_decode falls back to XLA otherwise), got "
+                f"{self.block_size}")
+
+    @property
+    def page_shape(self):
+        return (self.num_layers, self.num_blocks, self.block_size,
+                self.kv_heads, self.head_dim)
+
+    def max_blocks_per_seq(self, max_seq: int) -> int:
+        return blocks_for(max_seq, self.block_size)
+
+
+def init_kv_cache(cfg: KVCacheConfig, dtype=None):
+    """Zero-filled ``(k_pages, v_pages)`` pools, layer-stacked."""
+    import jax.numpy as jnp
+
+    dt = dtype if dtype is not None else (cfg.dtype or jnp.bfloat16)
+    k = jnp.zeros(cfg.page_shape, dt)
+    return k, jnp.zeros_like(k)
+
+
+def kv_cache_spec(axis: Optional[str]):
+    """PartitionSpec of a layer-stacked page pool: kv heads shard over the
+    TP axis (dim 3), everything else replicated — the serving twin of the
+    training head-sharding contract (a TP rank owns whole heads)."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, None, None, axis, None)

@@ -1,0 +1,427 @@
+"""Serving engine: prefill/decode step separation with continuous batching.
+
+No reference-file citation: NVIDIA Apex has no serving layer — this engine
+is ROADMAP item 3, the decode path of the framework: TWO jitted, SHAPE-STABLE
+programs (one prefill, one decode) over a fixed ``max_batch`` slot array,
+driven by a host loop that admits queued requests into free slots each tick
+and retires finished ones (continuous batching).
+
+Shape stability is the design law (the decode-recompile gotcha, CLAUDE.md):
+every decode tick ships identical shapes — the layer-stacked page pools, the
+``(max_batch, max_blocks)`` block table, int32 lengths/tokens, a bool active
+mask, per-slot PRNG keys, and a traced tick scalar — so the step compiles
+ONCE no matter how requests arrive, grow, and retire. Growing per-request KV
+shapes or python-int position leaks would recompile per token; the
+``lint.trace.decode_recompile_hazards`` tripwire checks the real argument
+stream stays clean.
+
+Tensor parallelism: the same step functions run inside ``shard_map`` over
+the model axis (kv heads shard with their attention heads; the embedding/
+projection collectives and the full-vocab logit gather are the mappings.py
+conjugates via the model's serve drives). Serial (``axis=None``) and sharded
+execution share one code path, like the rest of the framework.
+
+Weights import from training: pass params straight from a train loop or
+checkpoint; for fully-sharded (ZeRO-3) training state use
+:meth:`Engine.params_from_zero3` (``amp.MixedPrecisionOptimizer.
+zero3_materialize`` — gathers the 1/dp chunk trees back to full params).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.serve.cache import (
+    NULL_BLOCK,
+    BlockAllocator,
+    KVCacheConfig,
+    blocks_for,
+    init_kv_cache,
+    kv_cache_spec,
+)
+from apex_tpu.serve.sampler import fold_tick, sample_tokens
+from apex_tpu.serve.scheduler import ContinuousBatcher, Request
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine geometry + sampling knobs (all static: part of the compiled
+    programs' shapes, never traced)."""
+
+    max_batch: int = 4
+    max_seq: int = 128          # prompt + generation cap per request
+    prefill_len: Optional[int] = None  # prompt pad length (default max_seq)
+    block_size: int = 16
+    num_blocks: Optional[int] = None   # default: worst-case fit + null page
+    temperature: float = 0.0    # 0 = greedy
+    top_k: int = 0              # 0 = full distribution
+    seed: int = 0
+    eos_id: Optional[int] = None
+    decode_impl: Optional[str] = None  # override model attention_impl
+
+    def resolved(self) -> "ServeConfig":
+        pf = self.prefill_len or self.max_seq
+        nb = self.num_blocks
+        if nb is None:
+            nb = self.max_batch * blocks_for(self.max_seq,
+                                             self.block_size) + 1
+        return dataclasses.replace(self, prefill_len=min(pf, self.max_seq),
+                                   num_blocks=nb)
+
+
+class Engine:
+    """Paged-KV serving engine over a GPT-family model.
+
+    >>> eng = Engine(model, params, ServeConfig(max_batch=4, max_seq=128))
+    >>> eng.submit(Request(prompt=[1, 2, 3], max_new_tokens=16))
+    >>> results = eng.run(journal=journal)   # {request_id: Request}
+    """
+
+    def __init__(self, model, params, config: ServeConfig, mesh=None):
+        model.check_servable()
+        c = model.cfg
+        self.model = model
+        self.config = cfg = config.resolved()
+        self.mesh = mesh
+        self.axis = c.axis
+        if self.axis is not None and mesh is None:
+            raise ValueError(
+                "a TP-sharded model (cfg.axis set) needs the mesh — pass "
+                "mesh=, or build the serve model with axis=None")
+        if cfg.max_seq > c.max_seq_len:
+            raise ValueError(
+                f"max_seq ({cfg.max_seq}) exceeds the model's max_seq_len "
+                f"({c.max_seq_len})")
+        self._nb_per_seq = blocks_for(cfg.max_seq, cfg.block_size)
+        kv_cfg = KVCacheConfig(
+            num_layers=c.num_layers, kv_heads=c.num_attention_heads,
+            head_dim=c.head_dim, block_size=cfg.block_size,
+            num_blocks=cfg.num_blocks, dtype=c.compute_dtype)
+        self.kv_config = kv_cfg
+        self.allocator = BlockAllocator(kv_cfg.num_blocks)
+        self.batcher = ContinuousBatcher(cfg.max_batch)
+
+        # -- device state ---------------------------------------------------
+        k_pages, v_pages = init_kv_cache(kv_cfg)
+        if mesh is not None:
+            from apex_tpu.transformer import tensor_parallel as tp_mod
+
+            params = tp_mod.shard_params(params, model.specs(), mesh)
+            cspec = NamedSharding(mesh, kv_cache_spec(self.axis))
+            k_pages = jax.device_put(k_pages, cspec)
+            v_pages = jax.device_put(v_pages, cspec)
+        self.params = params
+        self._k_pages, self._v_pages = k_pages, v_pages
+
+        # -- host state (one row per slot) ----------------------------------
+        B = cfg.max_batch
+        self._tables = np.full((B, self._nb_per_seq), NULL_BLOCK, np.int32)
+        self._lengths = np.zeros((B,), np.int32)
+        self._active = np.zeros((B,), bool)
+        self._last_token = np.zeros((B,), np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(B)]
+        self._last_tok_t: List[Optional[float]] = [None] * B
+        # worst-case page RESERVATIONS per active slot (admission control):
+        # a request is only admitted when its whole-lifetime block need
+        # (prompt + max_new_tokens) fits under the unreserved pool, so
+        # mid-run growth (_ensure_capacity) can never hit an empty
+        # allocator — the no-preemption guarantee (see _admit)
+        self._slot_reserved = [0] * B
+        self._reserved_blocks = 0
+        self._base_keys = jax.random.split(
+            jax.random.PRNGKey(cfg.seed), B)  # (B, 2) uint32
+        self.ticks = 0
+
+        self._prefill_fn, self._decode_fn = self._build_steps()
+
+    # -- compiled programs --------------------------------------------------
+
+    def _build_steps(self):
+        model, cfg = self.model, self.config
+        temperature, top_k = cfg.temperature, cfg.top_k
+        # decode_impl override rides the model config (frozen dataclass):
+        # rebuild with the override so prefill/decode agree on the kernel
+        if cfg.decode_impl is not None:
+            model = type(self.model)(dataclasses.replace(
+                self.model.cfg, attention_impl=cfg.decode_impl))
+
+        def prefill(p, kp, vp, table_row, prompt, prompt_len, key, tick):
+            pf = prompt.shape[1]
+            pos = jnp.arange(pf, dtype=jnp.int32)
+            h = model.embed_at(p, prompt, pos[None])
+            h, ks, vs = model.serve_layers_prefill(p["layers"], h)
+            # (L, 1, nh, P, d) -> (L, P, nh, d): page rows are (head, dim)
+            ks = ks[:, 0].transpose(0, 2, 1, 3)
+            vs = vs[:, 0].transpose(0, 2, 1, 3)
+            blk = kp.shape[2]
+            flat = table_row[pos // blk] * blk + pos % blk
+            # padding rows land in the null page (never read)
+            flat = jnp.where(pos < prompt_len, flat, NULL_BLOCK)
+            pool = (kp.shape[0], kp.shape[1] * blk) + kp.shape[3:]
+            kp = kp.reshape(pool).at[:, flat].set(
+                ks.astype(kp.dtype)).reshape(kp.shape)
+            vp = vp.reshape(pool).at[:, flat].set(
+                vs.astype(vp.dtype)).reshape(vp.shape)
+            h_last = lax.dynamic_slice_in_dim(h, prompt_len - 1, 1, axis=1)
+            logits = model.serve_head(p, h_last)[:, 0]  # (1, vocab)
+            tok = sample_tokens(logits, fold_tick(key[None], tick),
+                                temperature=temperature, top_k=top_k)
+            return kp, vp, tok[0]
+
+        def decode(p, kp, vp, tables, lengths, tokens, active, keys, tick):
+            blk = kp.shape[2]
+            pos = lengths  # the new token's position (cache holds [0, pos))
+            blk_ids = jnp.take_along_axis(
+                tables, (pos // blk)[:, None], axis=1)[:, 0]
+            write_flat = jnp.where(active, blk_ids * blk + pos % blk,
+                                   NULL_BLOCK)
+            attend_len = jnp.where(active, pos + 1, 0)
+            h = model.embed_at(p, tokens[:, None], pos[:, None])
+            h, kp, vp = model.serve_layers_decode(
+                p["layers"], h, kp, vp, tables, write_flat, attend_len, pos)
+            logits = model.serve_head(p, h)[:, 0]  # (B, vocab)
+            tok = sample_tokens(logits, fold_tick(keys, tick),
+                                temperature=temperature, top_k=top_k)
+            return kp, vp, jnp.where(active, tok, 0)
+
+        if self.axis is None:
+            return jax.jit(prefill), jax.jit(decode)
+        specs = self.model.specs()
+        cspec = kv_cache_spec(self.axis)
+        r = P()  # replicated host-side state
+        prefill_sm = jax.shard_map(
+            prefill, mesh=self.mesh,
+            in_specs=(specs, cspec, cspec, r, r, r, r, r),
+            out_specs=(cspec, cspec, r), check_vma=False)
+        decode_sm = jax.shard_map(
+            decode, mesh=self.mesh,
+            in_specs=(specs, cspec, cspec, r, r, r, r, r, r),
+            out_specs=(cspec, cspec, r), check_vma=False)
+        return jax.jit(prefill_sm), jax.jit(decode_sm)
+
+    # -- request lifecycle --------------------------------------------------
+
+    def _worst_case_blocks(self, request: Request) -> int:
+        """The request's whole-lifetime page need: every generated token
+        may enter the cache, so admission reserves for prompt + max_new."""
+        return blocks_for(len(request.prompt) + request.max_new_tokens,
+                          self.config.block_size)
+
+    def submit(self, request: Request) -> None:
+        cfg = self.config
+        if len(request.prompt) > cfg.prefill_len:
+            raise ValueError(
+                f"prompt length {len(request.prompt)} exceeds prefill_len "
+                f"{cfg.prefill_len}")
+        if len(request.prompt) + request.max_new_tokens > cfg.max_seq:
+            raise ValueError(
+                f"prompt + max_new_tokens exceeds max_seq ({cfg.max_seq})")
+        usable = self.allocator.num_blocks - 1
+        if self._worst_case_blocks(request) > usable:
+            # a request the pool can NEVER hold would push back at every
+            # admit and spin the serve loop forever — fail at the door
+            raise ValueError(
+                f"request needs {self._worst_case_blocks(request)} pages "
+                f"worst-case but the pool has {usable}; grow num_blocks or "
+                f"shrink prompt/max_new_tokens")
+        if request.arrival_s is None:
+            request.arrival_s = time.perf_counter()
+        self.batcher.submit(request)
+
+    def decode_args(self, tick: int):
+        """The EXACT argument tuple a decode tick ships — the input stream
+        ``lint.trace.decode_recompile_hazards`` audits for shape churn.
+        (Decode folds the EVEN value 2*tick into the per-slot keys;
+        prefills fold odd values — disjoint draws, one signature.)"""
+        return (self.params, self._k_pages, self._v_pages,
+                jnp.asarray(self._tables), jnp.asarray(self._lengths),
+                jnp.asarray(self._last_token),
+                jnp.asarray(self._active), self._base_keys,
+                jnp.asarray(2 * tick, jnp.int32))
+
+    def _admit(self, journal) -> None:
+        """Fill free slots from the queue; one shape-stable prefill each.
+
+        Admission control is RESERVATION-based: a request enters only when
+        its worst-case lifetime page need fits under the pool minus every
+        active slot's reservation. Invariant (the no-preemption guarantee):
+        ``sum(reserved) <= usable`` and each slot allocates at most its
+        reservation, so ``allocator.available >= reserved_i - allocated_i``
+        for every slot — mid-run growth never finds the pool empty."""
+        cfg = self.config
+        placements = self.batcher.admit()
+        for i, (slot, req) in enumerate(placements):
+            usable = self.allocator.num_blocks - 1
+            need = self._worst_case_blocks(req)
+            if need > usable - self._reserved_blocks:
+                # pool pressure: unseat THIS and every later placement
+                # back to the queue head (original order) and stop —
+                # retirements will release reservations. A seated slot
+                # without its prefill would decode garbage forever.
+                for s2, r2 in reversed(placements[i:]):
+                    self.batcher.slots[s2] = None
+                    self.batcher.queue.appendleft(r2)
+                break
+            self._slot_reserved[slot] = need
+            self._reserved_blocks += need
+            plen = len(req.prompt)
+            blocks = self.allocator.alloc_many(
+                blocks_for(plen + 1, cfg.block_size))
+            self._slot_blocks[slot] = blocks
+            row = np.full((self._nb_per_seq,), NULL_BLOCK, np.int32)
+            row[:len(blocks)] = blocks
+            self._tables[slot] = row
+            prompt = np.zeros((1, cfg.prefill_len), np.int32)
+            prompt[0, :plen] = req.prompt
+            from apex_tpu.monitor import tracing as tracing_mod
+
+            with tracing_mod.maybe_span(
+                    tracing_mod.get_tracer(), "serve.prefill", cat="compute",
+                    slot=slot, prompt_len=plen) as sp:
+                # odd fold values: decode ticks fold 2t (decode_args), so
+                # a slot admitted at tick t never reuses the key its first
+                # decode draw folds in the same loop iteration
+                self._k_pages, self._v_pages, tok = self._prefill_fn(
+                    self.params, self._k_pages, self._v_pages,
+                    jnp.asarray(row), jnp.asarray(prompt),
+                    jnp.asarray(plen, jnp.int32), self._base_keys[slot],
+                    jnp.asarray(2 * self.ticks + 1, jnp.int32))
+                sp.barrier(tok)
+            first = int(np.asarray(tok))  # device fetch = TTFT barrier
+            t = time.perf_counter()
+            req.tokens.append(first)
+            req.ttft_s = (t - req.arrival_s
+                          if req.arrival_s is not None else None)
+            self._lengths[slot] = plen
+            self._last_token[slot] = first
+            self._active[slot] = True
+            self._last_tok_t[slot] = t
+            if journal is not None:
+                journal.log({"kind": "prefill", "request_id": req.request_id,
+                             "slot": slot, "prompt_len": plen,
+                             "ttft_s": req.ttft_s})
+
+    def _finished(self, req: Request) -> bool:
+        eos = self.config.eos_id
+        return (len(req.tokens) >= req.max_new_tokens
+                or (eos is not None and req.tokens
+                    and req.tokens[-1] == eos))
+
+    def _retire_finished(self, journal, results: Dict[Any, Request],
+                         now: float) -> None:
+        for slot, req in list(self.batcher.active.items()):
+            if not self._finished(req):
+                continue
+            self.batcher.retire(slot)
+            self.allocator.free(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+            self._reserved_blocks -= self._slot_reserved[slot]
+            self._slot_reserved[slot] = 0
+            self._tables[slot] = NULL_BLOCK
+            self._lengths[slot] = 0
+            self._active[slot] = False
+            self._last_token[slot] = 0
+            self._last_tok_t[slot] = None
+            req.finished_s = now
+            results[req.request_id] = req
+            if journal is not None:
+                gen_s = (now - (req.arrival_s or now))
+                journal.log({
+                    "kind": "request", "request_id": req.request_id,
+                    "prompt_len": len(req.prompt),
+                    "new_tokens": len(req.tokens),
+                    "ttft_s": req.ttft_s,
+                    "itl_s": [round(v, 6) for v in req.itl_s],
+                    "e2e_s": round(gen_s, 6),
+                })
+
+    def _ensure_capacity(self, slot: int) -> None:
+        """The next write position must have a page (continuous batching
+        grows a sequence one block at a time, on demand). Cannot fail:
+        the slot's admission reservation covers its whole lifetime
+        (see _admit's invariant)."""
+        pos = int(self._lengths[slot])
+        bi = pos // self.config.block_size
+        if self._tables[slot, bi] == NULL_BLOCK:
+            b = self.allocator.alloc()
+            self._slot_blocks[slot].append(b)
+            self._tables[slot, bi] = b
+
+    def _decode_tick(self, journal) -> None:
+        active = self.batcher.active
+        if not active:
+            return
+        for slot in active:
+            self._ensure_capacity(slot)
+        if journal is not None:
+            journal.step_start()
+        from apex_tpu.monitor import tracing as tracing_mod
+
+        with tracing_mod.maybe_span(
+                tracing_mod.get_tracer(), "serve.decode", cat="compute",
+                tick=self.ticks, active=len(active)) as sp:
+            self._k_pages, self._v_pages, toks = self._decode_fn(
+                *self.decode_args(self.ticks))
+            sp.barrier(toks)
+        toks_host = np.asarray(toks)  # device fetch stops the clock
+        t = time.perf_counter()
+        for slot, req in active.items():
+            tok = int(toks_host[slot])
+            self._lengths[slot] += 1  # the fed token is now cached
+            req.tokens.append(tok)
+            self._last_token[slot] = tok
+            if self._last_tok_t[slot] is not None:
+                req.itl_s.append(t - self._last_tok_t[slot])
+            self._last_tok_t[slot] = t
+        if journal is not None:
+            journal.step_end(
+                step=self.ticks, tokens=len(active),
+                queue_depth=self.batcher.queue_depth,
+                active_slots=len(active),
+                slot_occupancy=round(self.batcher.occupancy, 4))
+
+    # -- the serving loop ---------------------------------------------------
+
+    def run(self, requests: Optional[Sequence[Request]] = None, *,
+            journal=None, max_ticks: Optional[int] = None,
+            on_tick=None) -> Dict[Any, Request]:
+        """Serve until the queue and all slots drain (or ``max_ticks``).
+
+        ``on_tick(engine)`` runs after every tick — the open-loop request
+        generator hook (benchmarks/serve_bench.py injects arrivals there).
+        Returns ``{request_id: Request}`` with tokens + latency stamps
+        filled in; per-tick and per-request records land in ``journal``.
+        """
+        for r in requests or ():
+            self.submit(r)
+        results: Dict[Any, Request] = {}
+        while not self.batcher.idle:
+            if max_ticks is not None and self.ticks >= max_ticks:
+                break
+            self._admit(journal)
+            # a 1-token request is complete straight out of prefill
+            self._retire_finished(journal, results, time.perf_counter())
+            self._decode_tick(journal)
+            self._retire_finished(journal, results, time.perf_counter())
+            self.ticks += 1
+            if on_tick is not None:
+                on_tick(self)
+        return results
+
+    # -- training-state import ---------------------------------------------
+
+    @staticmethod
+    def params_from_zero3(mp_opt, zero3_setup, mesh, param_specs):
+        """Serve weights from a fully-sharded (ZeRO-3) training state: one
+        gather of the 1/dp chunk trees back to full params
+        (``amp.MixedPrecisionOptimizer.zero3_materialize`` — the export
+        path; the train loop itself never materializes the model)."""
+        return mp_opt.zero3_materialize(zero3_setup, mesh, param_specs)

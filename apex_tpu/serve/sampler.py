@@ -1,0 +1,54 @@
+"""Token sampling for the decode step: greedy, temperature, top-k.
+
+No reference-file citation: NVIDIA Apex has no serving layer; the sampling
+menu is the standard one (greedy argmax; temperature-scaled categorical;
+top-k truncation), written to run INSIDE the jitted decode step with
+per-slot PRNG keys so a tick's randomness is independent per request and
+reproducible per (slot key, tick).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jax.Array,
+    keys: Optional[jax.Array] = None,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Next-token ids ``(b,)`` from ``logits`` ``(b, vocab)``.
+
+    ``temperature == 0`` (the default) is greedy argmax — the decode path
+    of the serve equivalence gate (bit-matches the full-context forward's
+    argmax) — and uses no randomness. Otherwise ``keys`` ``(b, 2)`` uint32
+    (one PRNG key per slot; fold the tick in upstream) drives a categorical
+    draw over ``logits / temperature``, truncated to the ``top_k`` highest
+    logits when ``top_k > 0``. Static branches only: the choice is part of
+    the compiled program, never a traced conditional.
+    """
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if keys is None:
+        raise ValueError("temperature > 0 needs per-slot PRNG keys")
+    scaled = logits.astype(jnp.float32) / float(temperature)
+    if top_k:
+        k = min(int(top_k), logits.shape[-1])
+        kth = jax.lax.top_k(scaled, k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    draw = jax.vmap(jax.random.categorical)(keys, scaled)
+    return draw.astype(jnp.int32)
+
+
+def fold_tick(keys: jax.Array, tick: jax.Array) -> jax.Array:
+    """Per-tick keys from per-slot base keys: ``fold_in(key, tick)`` row-wise
+    — slot randomness stays independent across slots AND across ticks while
+    the decode signature stays shape-stable (tick is a traced scalar)."""
+    return jax.vmap(lambda k: jax.random.fold_in(k, tick))(keys)

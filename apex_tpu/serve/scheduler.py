@@ -1,0 +1,104 @@
+"""Continuous batching: a FIFO request queue over a fixed slot array.
+
+No reference-file citation: NVIDIA Apex has no serving layer — this is the
+host-side half of the Orca/vLLM continuous-batching loop: requests queue,
+free decode slots admit the queue head each tick (no waiting for the batch
+to drain), finished requests retire and their slot is immediately reusable.
+
+Pure host-side bookkeeping (no jax import): the engine owns device state;
+this class owns WHICH request sits in WHICH slot, so its invariants
+(FIFO admission order, no double-occupancy, slot reuse after retirement,
+queue-depth accounting) unit-test without a model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its lifecycle record."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    request_id: Any = None
+    arrival_s: Optional[float] = None  # host clock; engine stamps if None
+    # -- filled in by the engine --------------------------------------------
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None
+    itl_s: List[float] = dataclasses.field(default_factory=list)
+    finished_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.request_id is None:
+            self.request_id = next(_ids)
+        self.prompt = [int(t) for t in self.prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+class ContinuousBatcher:
+    """Slot occupancy + FIFO admission.
+
+    >>> b = ContinuousBatcher(max_slots=4)
+    >>> b.submit(req)
+    >>> for slot, req in b.admit():   # fills free slots, queue order
+    ...     engine.prefill(slot, req)
+    >>> b.retire(slot)                # slot free again next admit()
+    """
+
+    def __init__(self, max_slots: int):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_slots = int(max_slots)
+        self.queue: Deque[Request] = deque()
+        self.slots: List[Optional[Request]] = [None] * self.max_slots
+
+    # -- queue --------------------------------------------------------------
+    def submit(self, request: Request) -> None:
+        self.queue.append(request)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    # -- slots --------------------------------------------------------------
+    @property
+    def active(self) -> Dict[int, Request]:
+        return {i: r for i, r in enumerate(self.slots) if r is not None}
+
+    @property
+    def occupancy(self) -> float:
+        return sum(r is not None for r in self.slots) / self.max_slots
+
+    def admit(self) -> List[Tuple[int, Request]]:
+        """Place queued requests into free slots, FIFO, lowest slot first.
+        Returns the placements made this call."""
+        placed = []
+        for i in range(self.max_slots):
+            if not self.queue:
+                break
+            if self.slots[i] is None:
+                req = self.queue.popleft()
+                self.slots[i] = req
+                placed.append((i, req))
+        return placed
+
+    def retire(self, slot: int) -> Request:
+        req = self.slots[slot]
+        if req is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        self.slots[slot] = None
+        return req
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(r is None for r in self.slots)

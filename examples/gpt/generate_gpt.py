@@ -1,0 +1,177 @@
+"""GPT serving: prompts → tokens through the paged-KV inference engine.
+
+The decode-side sibling of pretrain_gpt.py: loads (or randomly initializes)
+a GPT, builds an ``apex_tpu.serve.Engine`` (paged KV cache, flash-decode,
+continuous batching over a fixed slot array), serves a prompt file, and
+prints per-request tokens plus TTFT/ITL latency. TP-sharded decode with
+``--tp``; sliding-window attention with ``--window``; the same ``--journal``
+/ ``--trace`` observability hooks as the trainers.
+
+Run on 8 virtual devices:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+        python examples/gpt/generate_gpt.py --tp 2 --max-new-tokens 16
+Prompt file format: one request per line, space-separated token ids.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.utils.compat import ensure_jax_compat
+
+ensure_jax_compat()  # jax<0.5: shard_map/axis_size API renames
+
+from apex_tpu import checkpoint
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.parallel import mesh as mesh_lib
+from apex_tpu.serve import Engine, Request, ServeConfig
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=50304)
+    p.add_argument("--max-seq", type=int, default=256)
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window attention (flash_attention/"
+                        "flash_decode window semantics)")
+    p.add_argument("--pos", default="learned",
+                   choices=["learned", "rope", "none"])
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; otherwise categorical at this "
+                        "temperature with per-slot PRNG keys")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--prompt-file", default=None,
+                   help="one request per line, space-separated token ids "
+                        "(default: a few synthetic prompts)")
+    p.add_argument("--load-dir", default=None,
+                   help="restore {'params': ...} from a training "
+                        "checkpoint dir (apex_tpu.checkpoint); ZeRO-3 "
+                        "states export via Engine.params_from_zero3")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="write per-tick + per-request JSON-lines metrics "
+                        "(TTFT/ITL/queue depth/occupancy; roll up with "
+                        "python -m apex_tpu.monitor.report)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write serve.prefill/serve.decode spans "
+                        "(apex_tpu.monitor.tracing) + a Chrome export "
+                        "next to PATH")
+    return p.parse_args()
+
+
+def load_prompts(args) -> list:
+    if args.prompt_file:
+        prompts = []
+        with open(args.prompt_file) as f:
+            for line in f:
+                toks = [int(t) % args.vocab for t in line.split()]
+                if toks:
+                    prompts.append(toks)
+        return prompts
+    rng = np.random.default_rng(args.seed)
+    return [list(rng.integers(0, args.vocab, n))
+            for n in (5, 12, 3, 9, 17, 7)]
+
+
+def main():
+    args = parse_args()
+    mesh = None
+    if args.tp > 1:
+        mesh = mesh_lib.make_virtual_mesh(
+            len(jax.devices()), tensor_model_parallel_size=args.tp)
+    cfg = GPTConfig(
+        vocab_size=args.vocab,
+        hidden_size=args.hidden,
+        num_layers=args.layers,
+        num_attention_heads=args.heads,
+        max_seq_len=args.max_seq,
+        hidden_dropout=0.0,
+        axis=mesh_lib.AXIS_MODEL if args.tp > 1 else None,
+        compute_dtype=jnp.float32,
+        remat=False,
+        attention_window=args.window,
+        position_embedding=args.pos,
+    )
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed))
+    if args.load_dir:
+        params = checkpoint.restore_checkpoint(
+            args.load_dir, {"params": params})["params"]
+        print(f"restored params from {args.load_dir}")
+
+    tracer = None
+    if args.trace:
+        from apex_tpu.monitor import tracing
+
+        tracer = tracing.arm(args.trace,
+                             meta={"run": "generate_gpt", "tp": args.tp})
+    journal = None
+    if args.journal:
+        from apex_tpu.monitor import MetricsJournal
+
+        journal = MetricsJournal(
+            args.journal,
+            meta={"run": "generate_gpt", "tp": args.tp,
+                  "max_batch": args.max_batch, "max_seq": args.max_seq,
+                  "block_size": args.block_size,
+                  "window": args.window or 0})
+
+    engine = Engine(model, params, ServeConfig(
+        max_batch=args.max_batch, max_seq=args.max_seq,
+        block_size=args.block_size, temperature=args.temperature,
+        top_k=args.top_k, seed=args.seed), mesh=mesh)
+    prompts = load_prompts(args)
+    budget = args.max_seq - args.max_new_tokens
+    reqs = [Request(prompt=pr[:max(budget, 1)],
+                    max_new_tokens=args.max_new_tokens, request_id=i)
+            for i, pr in enumerate(prompts)]
+    results = engine.run(reqs, journal=journal)
+
+    for rid in sorted(results):
+        r = results[rid]
+        itl_ms = (1e3 * float(np.median(r.itl_s)) if r.itl_s else None)
+        print(f"request {rid}: prompt {len(r.prompt)} tok -> "
+              f"{len(r.tokens)} new | ttft {1e3 * r.ttft_s:.1f} ms | "
+              f"itl p50 {itl_ms and round(itl_ms, 2)} ms")
+        print(f"  tokens: {r.tokens}")
+    print(f"{len(results)} request(s) in {engine.ticks} decode tick(s) | "
+          f"mesh tp={args.tp} | pool "
+          f"{engine.allocator.num_blocks - 1} x {args.block_size} tokens")
+
+    if journal is not None:
+        journal.close()
+    if tracer is not None:
+        from apex_tpu.monitor import tracing
+
+        tracing.disarm()
+        try:
+            tracing.write_chrome_trace(args.trace,
+                                       args.trace + ".chrome.json")
+            print(f"chrome trace: {args.trace}.chrome.json")
+        except Exception as e:  # noqa: BLE001
+            print(f"chrome export failed: {e}")
+    if mesh is not None:
+        mesh_lib.destroy_model_parallel()
+
+
+if __name__ == "__main__":
+    main()

@@ -843,3 +843,48 @@ def test_step_report_composite():
     assert rep["lane_padding"]["flagged"] >= 1
     assert rep["lane_padding"]["worst"][0]["shape"] == [512, 1]
     assert [h["kind"] for h in rep["recompile_hazards"]] == ["python-scalar"]
+
+
+# ---------------------------------------------------------------------------
+# decode-recompile tripwire (serving; the real engine stream is pinned in
+# tests/test_serve.py)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_recompile_flags_growing_kv_and_scalar_leaks():
+    """A decode argument stream whose per-request KV grows with the
+    sequence — or that ships python-int positions — is one recompile per
+    generated token (the latency cliff the paged cache exists to
+    prevent)."""
+    grow = trace.decode_recompile_hazards(
+        lambda t: (jnp.ones((1, 2, t + 4, 8), jnp.float32),
+                   jnp.zeros((2,), jnp.int32)), ticks=3)
+    assert grow["hazard"]
+    rules = {f["rule"] for f in grow["findings"]}
+    assert "decode-shape-churn" in rules
+    assert any("recompile" in f["message"] for f in grow["findings"])
+
+    leak = trace.decode_recompile_hazards(
+        lambda t: (jnp.ones((4,), jnp.float32), {"tick": t}), ticks=2)
+    assert leak["hazard"]
+    assert any(f.get("kind") == "python-scalar" for f in leak["findings"])
+
+    struct = trace.decode_recompile_hazards(
+        lambda t: tuple(jnp.zeros((2,), jnp.int32) for _ in range(t + 1)),
+        ticks=2)
+    assert struct["hazard"]
+    assert struct["findings"][0]["rule"] == "decode-structure-churn"
+
+
+def test_decode_recompile_passes_shape_stable_stream():
+    """The engine contract: identical shapes/dtypes every tick — fixed
+    slot arrays, the paged pool, committed int32 positions, a traced
+    tick scalar."""
+    def args(t):
+        return (jnp.zeros((2, 8, 4, 4), jnp.float32),   # page pool
+                jnp.zeros((4, 6), jnp.int32),            # block tables
+                jnp.zeros((4,), jnp.int32),              # lengths
+                jnp.asarray(t, jnp.int32))               # traced tick
+
+    ok = trace.decode_recompile_hazards(args, ticks=4)
+    assert not ok["hazard"] and ok["ticks"] == 4 and ok["leaves"] == 4
