@@ -36,6 +36,13 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   params must stay 1/n chunks gathered just-in-time per layer
   (models/_transformer.run_layers ``chunk_meta``); a whole-stack or
   post-update bulk gather silently returns peak HBM to O(model).
+- ``unprefetched-gather`` (:func:`unprefetched_gather_hazards`) -- an
+  UNROLLED ZeRO-3 step whose per-layer chunk all-gathers sit inside the
+  rematerialized layer bodies: each gather (and its backward re-gather)
+  is then strictly serialized with that layer's compute, so the exposed
+  gather time the step-anatomy overlap fraction measures cannot shrink;
+  the double-buffered drive (``zero3_prefetch``) lifts them out as free
+  equations issued N layers ahead.
 - ``untimed-schedule``  (:func:`untimed_schedule_hazards`) -- a pipeline
   schedule drive that ran while a span tracer was armed but emitted no
   pipe spans (``monitor/tracing.py``): the step-anatomy layer exists so
@@ -604,6 +611,106 @@ def zero3_gather_hazards(fn, *args,
         "bulk_gathers": n_bulk,
         "layer_gathers": sum(census["per_layer"].values()),
         "min_model_elems": int(min_model_elems),
+        "findings": findings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-3 gather-prefetch tripwire
+# ---------------------------------------------------------------------------
+
+#: primitives that open a rematerialized region (jax.checkpoint lowers to
+#: remat2 on this jax; older/newer spellings kept for robustness)
+_REMAT_PRIMS = ("remat", "remat2", "checkpoint")
+
+
+def prefetch_gather_census(jaxpr, zero_axis: str) -> Dict[str, int]:
+    """Classify every ``all_gather`` over ``zero_axis`` by whether it sits
+    INSIDE a rematerialized region (``jax.checkpoint`` body — the
+    serialized ZeRO-3 drive's in-body gather, re-issued inside the
+    backward's recompute and pinned to that body's schedule) or stands
+    FREE in the surrounding jaxpr (the double-buffered drive's
+    structurally prefetchable form, ``models/_transformer.
+    _prefetched_zero3_drive``). Counts are call sites per trace."""
+    fused = free = regions = 0
+
+    def walk(jx, in_remat):
+        nonlocal fused, free, regions
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if (name == "all_gather"
+                    and zero_axis in _eqn_axis_names(eqn)):
+                if in_remat:
+                    fused += 1
+                else:
+                    free += 1
+            sub_remat = in_remat or name in _REMAT_PRIMS
+            if name in _REMAT_PRIMS:
+                regions += 1
+            for sub in _sub_jaxprs(eqn):
+                walk(sub, sub_remat)
+
+    walk(jaxpr, False)
+    return {"fused": fused, "free": free, "remat_regions": regions}
+
+
+def unprefetched_gather_hazards(fn, *args,
+                                zero_axis: str = "data",
+                                axes: Optional[Dict[str, int]] = None,
+                                min_fused: int = 2,
+                                **kwargs) -> Dict[str, Any]:
+    """Verify a ZeRO-3 UNROLLED step double-buffers its per-layer gathers.
+
+    Traces ``fn(*args)`` under ``axes`` (omit when ``fn`` binds its own
+    axes via shard_map) and censuses ``all_gather`` call sites over
+    ``zero_axis`` by remat containment (:func:`prefetch_gather_census`).
+    The serialized chunk drive gathers each layer's weights INSIDE the
+    rematerialized body: the gather is then pinned to that body's schedule
+    — the forward issues it back-to-back with the body's compute and the
+    backward re-issues it inside the recompute, strictly serialized with
+    the cotangent chain — so no jaxpr-level ordering (and no
+    latency-hiding hoist across the remat's optimization barriers) can
+    start layer i+1's gather under layer i's compute. The double-buffered
+    drive (``GPTConfig.zero3_prefetch``; ``models/_transformer.
+    _prefetched_zero3_drive``) lifts the gathers out of remat into free
+    equations issued ``prefetch`` layers ahead, which is the structure
+    this analyzer accepts.
+
+    Hazard iff >= ``min_fused`` remat-fused gathers (the per-layer
+    unrolled pattern; a lax.scan drive books ONE in-body gather site and
+    is out of scope — this tripwire polices the unrolled path the
+    prefetch knob exists for). Returns ``{hazard, census, fused_gathers,
+    free_gathers, findings}`` — call-site counts per trace, like
+    :func:`zero3_gather_hazards`.
+    """
+    import jax
+
+    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
+        jaxpr = fn.jaxpr
+    else:
+        env = list(axes.items()) if axes else None
+        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    census = prefetch_gather_census(jaxpr, zero_axis)
+    findings = []
+    if census["fused"] >= min_fused:
+        findings.append({
+            "rule": "unprefetched-gather",
+            "message": (
+                f"step jaxpr carries {census['fused']} per-layer "
+                f"all_gather(s) on the '{zero_axis}' axis INSIDE "
+                f"rematerialized bodies in an unrolled ZeRO-3 step -- each "
+                f"gather is serialized with its layer's compute (and its "
+                f"backward re-gather with the recompute chain); "
+                f"double-buffer them with zero3_prefetch > 0 so layer "
+                f"i+N's gather issues before layer i's compute "
+                f"(models/_transformer._prefetched_zero3_drive)"),
+            "verb": "all_gather", "extra": census["fused"],
+        })
+    return {
+        "hazard": bool(findings),
+        "census": census,
+        "fused_gathers": census["fused"],
+        "free_gathers": census["free"],
         "findings": findings,
     }
 

@@ -133,6 +133,73 @@ def _remat_policy(name: Optional[str]):
     raise ValueError(f"unknown remat_policy {name!r}")
 
 
+def _prefetched_zero3_drive(layer_fn, gather_fn, n: int, prefetch: int):
+    """Software-pipelined (double-buffered) ZeRO-3 layer drive for the
+    UNROLLED path: issue layer ``i+prefetch``'s chunk all-gather before
+    layer ``i``'s compute, forward AND backward, so the gathers stand as
+    structurally independent collectives ahead of the compute that hides
+    them (the cross-replica weight-sharding layout of Xu et al. driven as
+    an explicit prefetch schedule; tripwire:
+    ``lint.trace.unprefetched_gather_hazards``).
+
+    The serialized drive keeps each gather INSIDE the rematerialized scan
+    body (run_layers ``chunk_meta``), which pins it to that body's
+    schedule; this drive replaces ``jax.checkpoint`` with a
+    ``jax.custom_vjp`` whose backward re-gathers each layer's weights
+    (prefetched ``prefetch`` layers ahead of the reverse sweep) and
+    rematerializes the layer forward under a fresh ``jax.vjp`` — identical
+    remat semantics, same math (the gather's AD transpose still
+    reduce-scatters that layer's grads on the spot, via ``jax.vjp`` of the
+    same gather), but the gathered weights are never residuals: peak param
+    residency is ``prefetch + 1`` layers plus chunks.
+
+    ``layer_fn(p_full, h) -> h``; ``gather_fn(chunk_row) -> p_full``;
+    ``n`` = layer count. Returns ``drive(chunks, h) -> h`` (chunks: the
+    ``(L, k)`` per-row chunk stack).
+    """
+    pf = max(int(prefetch), 0)
+
+    def _row(chunks, i):
+        return jax.tree.map(lambda v: v[i], chunks)
+
+    def _fwd(chunks, h):
+        window = [gather_fn(_row(chunks, j)) for j in range(min(pf, n))]
+        hs = []
+        for i in range(n):
+            if i + pf < n:
+                # layer i+pf's gather is issued BEFORE layer i's compute
+                window.append(gather_fn(_row(chunks, i + pf)))
+            p = window.pop(0)
+            hs.append(h)
+            h = layer_fn(p, h)
+        return h, (chunks, jnp.stack(hs))
+
+    def _bwd(res, g):
+        chunks, h_stack = res
+        idxs = list(reversed(range(n)))
+        window = [jax.vjp(gather_fn, _row(chunks, j))
+                  for j in idxs[:min(pf, n)]]
+        g_rows = [None] * n
+        for pos, i in enumerate(idxs):
+            if pos + pf < n:
+                # the backward RE-gather for the layer prefetch steps
+                # ahead of the current layer's VJP compute
+                window.append(jax.vjp(gather_fn, _row(chunks, idxs[pos + pf])))
+            p, gvjp = window.pop(0)
+            _, lvjp = jax.vjp(layer_fn, p, h_stack[i])
+            g_p, g = lvjp(g)
+            (g_rows[i],) = gvjp(g_p)
+        g_chunks = jax.tree.map(lambda *rows: jnp.stack(rows), *g_rows)
+        return g_chunks, g
+
+    @jax.custom_vjp
+    def drive(chunks, h):
+        return _fwd(chunks, h)[0]
+
+    drive.defvjp(_fwd, _bwd)
+    return drive
+
+
 def stack_specs(spec_tree):
     """Prefix each PartitionSpec with the stacked (num_layers) dim."""
     return jax.tree.map(
@@ -495,9 +562,13 @@ class TransformerBase:
         off): backward then RE-GATHERS each layer instead of saving the
         gathered weights as residuals, and the gather's AD transpose
         reduce-scatters that layer's grads on the spot. On the unrolled
-        path the per-layer gathers are static, independent collectives —
-        the prefetch schedule XLA's latency-hiding scheduler can hoist
-        (gather layer i+1 while layer i computes).
+        path the per-layer gathers are static, independent collectives;
+        with ``cfg.zero3_prefetch > 0`` they are DOUBLE-BUFFERED
+        explicitly (:func:`_prefetched_zero3_drive`: layer i+prefetch's
+        gather issues before layer i's compute, forward and backward)
+        instead of leaving the overlap to XLA's latency-hiding scheduler
+        — the structural form the ``unprefetched_gather_hazards``
+        tripwire checks for (peak residency: prefetch+1 layers + chunks).
 
         When the model's layers emit aux losses (``_aux_init`` not None),
         they accumulate in the scan carry and the caller MUST pass
@@ -516,6 +587,32 @@ class TransformerBase:
             )
         if chunk_meta is not None:
             from apex_tpu.optimizers.distributed import gather_chunked_tree
+
+            prefetch = int(getattr(self.cfg, "zero3_prefetch", 0) or 0)
+            if prefetch > 0:
+                if not getattr(self.cfg, "unroll_layers", False):
+                    raise ValueError(
+                        "zero3_prefetch needs unroll_layers=True: the "
+                        "double-buffered gather schedule is a static "
+                        "unrolled structure (a lax.scan has one gather "
+                        "call site to prefetch around)")
+                if aux0 is not None:
+                    raise ValueError(
+                        "zero3_prefetch does not support aux-emitting "
+                        "layers (MoE routers) — ZeRO rejects data-sharded "
+                        "experts anyway")
+                if keys is not None or attn_bias is not None:
+                    raise NotImplementedError(
+                        "zero3_prefetch drives the dense dropout-off path "
+                        "only: the custom-VJP drive would need dropout-key"
+                        "/attention-bias cotangent plumbing no ZeRO-3 "
+                        "harness exercises")
+                drive = _prefetched_zero3_drive(
+                    lambda p, hh: self._layer(p, hh, None, None),
+                    lambda c: gather_chunked_tree(c, chunk_meta),
+                    n, prefetch)
+                h = drive(layers, h)
+                return (h, None) if return_aux else h
 
         def body(carry, xs):
             h, acc = carry

@@ -76,6 +76,11 @@ class BertConfig:
     # avoids the layer scan's dynamic-update-slice grad stacking — see
     # GPTConfig.unroll_layers and PERF_NOTES r5
     unroll_layers: bool = False
+    # ZeRO-3 gather prefetch depth on the unrolled path (double-buffered
+    # per-layer chunk all-gathers — see GPTConfig.zero3_prefetch); the
+    # prefetch drive is dense/dropout-off only, so BERT runs it through
+    # the pipelined ZeRO-3 step, not the SegmentMask attention path
+    zero3_prefetch: int = 0
     # sequence (context) parallelism over this mesh axis — the shared
     # TransformerBase._attend ring/Ulysses path (bidirectional here).
     # Padding attention_masks work: they become segment ids whose kv

@@ -106,6 +106,15 @@ class GPTConfig:
     # of O(1) — fine at flagship depth, keep False for very deep or
     # pipelined configs (pipeline stages already slice the stack).
     unroll_layers: bool = False
+    # ZeRO-3 gather prefetch depth (unrolled path only): double-buffer the
+    # per-layer just-in-time chunk all-gathers — issue layer i+N's gather
+    # before layer i's compute, forward AND backward re-gathers
+    # (models/_transformer._prefetched_zero3_drive), so the gathers stand
+    # structurally ahead of the compute that hides them instead of pinned
+    # inside the rematerialized body. 0 = the serialized in-body gather;
+    # N=1 is classic double buffering. Peak param residency grows to
+    # N+1 layers + chunks. Tripwire: lint.trace.unprefetched_gather_hazards.
+    zero3_prefetch: int = 0
     # chunked fused LM-head CE (ops/lm_head_loss): avoids materializing the
     # (tokens, vocab) logits when computing the loss. Serial (axis=None) only;
     # under TP the vocab is already sharded V/tp ways.

@@ -500,6 +500,7 @@ def compare(
     hbm_slack_bytes: int = 64 << 20,
     loss_threshold: Optional[float] = None,
     bubble_threshold: Optional[float] = None,
+    overlap_threshold: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Compare run B against baseline A; ``regressed`` iff B is worse.
 
@@ -522,6 +523,14 @@ def compare(
     learning progress given back" — the machine gate for paired
     fp32-wire vs quantized-wire training runs (the quantized-collectives
     convergence bar, parallel/quantize.py).
+
+    ``overlap_threshold`` tunes the comm/compute OVERLAP gate (defaults
+    to ``threshold`` when journals carry ``overlap_fraction`` stamps —
+    ``set_step_comm``'s step-anatomy join): B's overlap fraction must not
+    DROP past it — the machine gate for structural-prefetch work (the
+    ZeRO-3 double-buffered gathers whose win IS the overlap fraction,
+    ``models/_transformer._prefetched_zero3_drive``), sharing the same
+    :func:`must_not_drop` predicate as throughput.
 
     Serving journals (``kind="request"`` records from ``apex_tpu.serve``)
     gate symmetrically: B must still serve requests when A did, TTFT/ITL
@@ -621,6 +630,15 @@ def compare(
           worse=must_not_grow(
               threshold if bubble_threshold is None else bubble_threshold,
               slack=0.01))
+    # comm/compute overlap fraction (set_step_comm's step-anatomy join):
+    # regression = the measured overlap DROPS past the tolerance — the
+    # machine gate for structural-prefetch work (ZeRO-3 double-buffered
+    # gathers); higher is better, so the drop predicate
+    check("overlap_fraction_p50",
+          ((ra.get("timeline") or {}).get("overlap_fraction") or {}).get("p50"),
+          ((rb.get("timeline") or {}).get("overlap_fraction") or {}).get("p50"),
+          worse=must_not_drop(
+              threshold if overlap_threshold is None else overlap_threshold))
     # serving latency gates (kind="request" journals from the serve
     # engine): TTFT/ITL p50 must not GROW past the threshold — the same
     # machine gate training throughput gets, pointed at the latency-shaped
@@ -678,6 +696,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                        help="max fractional growth in the pipeline bubble "
                             "fraction (defaults to --threshold when "
                             "journals carry bubble_fraction stamps)")
+        p.add_argument("--overlap-threshold", type=float, default=None,
+                       help="max fractional DROP in the comm/compute "
+                            "overlap fraction (defaults to --threshold "
+                            "when journals carry overlap_fraction stamps "
+                            "— the structural-prefetch gate)")
         p.add_argument("--json", action="store_true",
                        help="print the full comparison as one JSON object")
         args = p.parse_args(argv[1:])
@@ -686,7 +709,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       # MiB, matching compare()'s 64 << 20 default exactly
                       hbm_slack_bytes=int(args.hbm_slack_mb * (1 << 20)),
                       loss_threshold=args.loss_threshold,
-                      bubble_threshold=args.bubble_threshold)
+                      bubble_threshold=args.bubble_threshold,
+                      overlap_threshold=args.overlap_threshold)
         if args.json:
             print(json.dumps(res))
         else:

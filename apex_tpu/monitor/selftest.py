@@ -30,7 +30,9 @@ that the monitor pieces stay importable and functional:
    quantized-collective tripwire (a surviving fp32 bulk reduce payload in
    a step that requests a quantized grad reduce, and a quantized grad
    reduce with no error-feedback residual leaf; the encoded all_to_all
-   pair with a residual passes).
+   pair with a residual passes), plus the gather-prefetch tripwire
+   (per-layer ZeRO-3 gathers fused inside rematerialized bodies flag;
+   the double-buffered free-standing gathers pass).
 
 9. tracing: nested spans round-trip with depths and strict-JSON
    non-finite handling; a torn trace file still parses; the analytic
@@ -377,6 +379,38 @@ def _check_lint() -> dict:
                                             model_elems=L * 512)
     assert not z3_ok["hazard"] and z3_ok["layer_gathers"] == L, z3_ok
 
+    # engine 2, ZeRO-3 gather-prefetch tripwire: per-layer gathers INSIDE
+    # rematerialized bodies (the serialized unrolled drive) are pinned to
+    # their layer's schedule; gathers standing free ahead of the compute
+    # (the zero3_prefetch double-buffered drive) pass
+    import jax as _jax
+
+    row = (16, 16)
+    chunks8 = jnp.ones((4, 32), jnp.float32)  # 4 layers, k=32 at n=8
+
+    def _serialized(c, h):
+        for i in range(4):
+            body = _jax.checkpoint(
+                lambda ci, hh: jnp.tanh(
+                    hh @ gather_leaf(ci, row, jnp.float32, "data")))
+            h = body(c[i], h)
+        return jnp.sum(h * h)
+
+    def _prefetched(c, h):
+        gathered = [gather_leaf(c[i], row, jnp.float32, "data")
+                    for i in range(4)]
+        for p in gathered:
+            h = jnp.tanh(h @ p)
+        return jnp.sum(h * h)
+
+    h0 = jnp.ones((2, 16), jnp.float32)
+    pg_bad = lint_trace.unprefetched_gather_hazards(
+        _jax.grad(_serialized, argnums=0), chunks8, h0, axes={"data": 8})
+    assert pg_bad["hazard"] and pg_bad["fused_gathers"] >= 2, pg_bad
+    pg_ok = lint_trace.unprefetched_gather_hazards(
+        _jax.grad(_prefetched, argnums=0), chunks8, h0, axes={"data": 8})
+    assert not pg_ok["hazard"] and pg_ok["free_gathers"] >= 4, pg_ok
+
     # engine 2, quantized-collective tripwire: a surviving fp32 bulk
     # reduce payload in a step that requests a quantized grad reduce is
     # the fat-wire regression; the encoded all_to_all pair passes, and a
@@ -465,12 +499,21 @@ def _check_tracing() -> dict:
         os.unlink(path)
 
     # analytic floors at hand-computable points: the SPMD ring's
-    # (S-1)/(vpp*M+S-1), 1F1B's (S-1)/(M+S-1), the zero-bubble target
+    # (S-1)/(vpp*M+S-1), 1F1B's (S-1)/(M+S-1), and the zero-bubble
+    # W/B-split floor (S-1)/(3M+S-1) — the greedy planner must COUNT the
+    # same fraction its closed form claims (schedule-as-data: the plan is
+    # the ground truth)
     ebf = tracing.expected_bubble_fraction
     assert abs(ebf("interleaved", 8, 4, 2) - 3 / 19) < 1e-12
     assert abs(ebf("1f1b", 8, 4) - 3 / 11) < 1e-12
-    assert ebf("zero-bubble", 8, 4) == 0.0
+    assert abs(ebf("zero-bubble", 8, 4) - 3 / 27) < 1e-12
     assert ebf("interleaved", 8, 1) == 0.0  # no pipeline, no bubble
+    from apex_tpu.transformer.pipeline_parallel import plan_schedule
+
+    for sched in ("gpipe", "1f1b", "zero-bubble"):
+        plan = plan_schedule(sched, 8, 4)
+        assert abs(plan.bubble_fraction() - ebf(sched, 8, 4)) < 1e-12, (
+            sched, plan.bubble_fraction())
 
     # anatomy invariant at a hand point: 0.06s compute + 0.06s comm in a
     # 0.1s wall → 0.02s overlapped (1/3 of the cheaper side), fractions

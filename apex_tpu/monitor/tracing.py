@@ -13,8 +13,9 @@ and turns span files into judgments:
   and the measured per-rank bubble fraction;
 - :func:`expected_bubble_fraction`: the analytic floor each measured run
   is compared against — the fill/drain algebra of schedules.py's SPMD
-  ring ((S-1)/(vpp*M+S-1)) and the named schedules ROADMAP item 5's
-  future schedule work must beat (gpipe/1f1b/interleaved/zero-bubble);
+  ring ((S-1)/(vpp*M+S-1)) and of the schedule-as-data planners
+  (gpipe/1f1b/interleaved/zero-bubble; the zero-bubble engine's W/B
+  split lands at (S-1)/(3M+S-1), schedules.plan_schedule);
 - :func:`step_anatomy` / :func:`overlap_fraction`: measured wall time
   joined against the pyprof cost model (monitor/mfu.py peak specs) and
   collective payload bytes over the ICI bandwidth table — compute vs
@@ -392,8 +393,15 @@ def expected_bubble_fraction(schedule: str, num_microbatches: int,
     - ``"interleaved"``: ``(S-1)/(vpp*M+S-1)`` — the vpp-chunk placement
       of schedules.py's SPMD ring (``pipeline_tick_count``); vpp=1
       degenerates to 1F1B;
-    - ``"zero-bubble"``: 0.0 — the ROADMAP item 5 target (splitting
-      weight-grad from input-grad compute fills the cooldown).
+    - ``"zero-bubble"``: ``(S-1)/(3M+S-1)`` — the W/B split
+      (``schedules.plan_schedule``) factors each backward slot into an
+      input-grad and a weight-grad slot, so a rank's timeline is ``3M``
+      live slots and the ``bwd_weight`` slots of early microbatches fill
+      what 1F1B spends idle in the cooldown: per-rank idles drop from
+      ``2(S-1)`` (out of ``2(M+S-1)`` ticks) to the ``S-1`` fill ticks no
+      schedule can remove (rank s has no input before tick s). The greedy
+      planner meets this floor exactly (tests pin plan-counted ==
+      closed-form).
 
     Measured runs (:func:`pipeline_anatomy`) are compared against this
     floor; ``report compare --bubble-threshold`` gates regressions.
@@ -408,8 +416,8 @@ def expected_bubble_fraction(schedule: str, num_microbatches: int,
         return (S - 1) / (M + S - 1)
     if name in ("interleaved", "1f1b-interleaved", "vpp"):
         return (S - 1) / (v * M + S - 1)
-    if name in ("zero-bubble", "zb"):
-        return 0.0
+    if name in ("zero-bubble", "zb", "zerobubble"):
+        return (S - 1) / (3 * M + S - 1)
     raise ValueError(f"unknown schedule {schedule!r}; known: {SCHEDULES}")
 
 

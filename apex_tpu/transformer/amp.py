@@ -76,6 +76,7 @@ def build_zero_train_step(
     with_aux: bool = False,
     traced: bool = False,
     tracer=None,
+    pipe_value_and_grad=None,
 ):
     """One jitted GPT train step with the whole ZeRO update inside a single
     ``shard_map``: backward, spec-aware grad reduction over every
@@ -118,6 +119,17 @@ def build_zero_train_step(
     (params, opt_state, loss, metrics)`` with the loss unscaled; at level
     3 ``params`` is the persistent chunk tree (``zero3.params``).
 
+    ``pipe_value_and_grad`` swaps the backward's DERIVATION: instead of
+    ``jax.value_and_grad`` of ``pipe_loss`` (the AD-transposed SPMD ring),
+    pass ``(rest, layers, toks, tgts, scale) -> (scaled_loss, rest_g,
+    layer_g)`` — e.g. ``schedules.schedule_grads_fn(plan_schedule(
+    "zero-bubble", ...))``, whose EXPLICIT backward slots are the only way
+    the W/B split can fill the pipeline cooldown. Levels 1/2 only (the
+    ZeRO-3 branch rebuilds the pipelined loss itself); the grads contract
+    is identical (per-stage partial rest grads, per-stage layer chunks),
+    so the spec-aware reduction and the sharded optimizer see no
+    difference.
+
     ``traced=True`` (the ``--trace``/``BENCH_TRACE`` opt-in) splits the
     step into its two anatomy phases — backward+reduction
     (``zero.grads``, the ZeRO-3 just-in-time gathers and their
@@ -154,6 +166,12 @@ def build_zero_train_step(
         return rest_g, layer_g
 
     if getattr(mp_opt, "zero_level", 2) >= 3:
+        if pipe_value_and_grad is not None:
+            raise ValueError(
+                "pipe_value_and_grad (the zero-bubble schedule engine) "
+                "composes with ZeRO levels 1/2 only: the level-3 branch "
+                "rebuilds the pipelined loss around the fully-sharded "
+                "chunk drive")
         if zero3 is None or model is None or num_microbatches is None:
             raise ValueError(
                 "zero_level=3 needs zero3=(mp_opt.zero3_init(...)), model= "
@@ -234,15 +252,24 @@ def build_zero_train_step(
             traced_state_specs = zero3.state_specs
     else:
 
-        def zero_step(p, opt_state, toks, tgts):
-            rest = {k: v for k, v in p.items() if k != "layers"}
+        def value_and_grad(rest, layers, toks, tgts, scale):
+            if pipe_value_and_grad is not None:
+                # explicit-backward schedule engine (zero-bubble W/B
+                # split); same (loss, rest_g, layer_g) contract as the
+                # AD path below
+                return pipe_value_and_grad(rest, layers, toks, tgts, scale)
 
             def scaled_loss(rest, layers):
-                return pipe_loss(rest, layers, toks, tgts) \
-                    * opt_state.scaler.loss_scale
+                return pipe_loss(rest, layers, toks, tgts) * scale
 
             loss, (rest_g, layer_g) = jax.value_and_grad(
-                scaled_loss, argnums=(0, 1))(rest, p["layers"])
+                scaled_loss, argnums=(0, 1))(rest, layers)
+            return loss, rest_g, layer_g
+
+        def zero_step(p, opt_state, toks, tgts):
+            rest = {k: v for k, v in p.items() if k != "layers"}
+            loss, rest_g, layer_g = value_and_grad(
+                rest, p["layers"], toks, tgts, opt_state.scaler.loss_scale)
             rest_g, layer_g = reduce_nonzero(rest_g, layer_g)
             new_p, new_state, metrics = mp_opt.apply_gradients(
                 opt_state, p, dict(rest_g, layers=layer_g),
@@ -260,13 +287,9 @@ def build_zero_train_step(
 
             def traced_grads(p, opt_state, toks, tgts):
                 rest = {k: v for k, v in p.items() if k != "layers"}
-
-                def scaled_loss(rest, layers):
-                    return pipe_loss(rest, layers, toks, tgts) \
-                        * opt_state.scaler.loss_scale
-
-                loss, (rest_g, layer_g) = jax.value_and_grad(
-                    scaled_loss, argnums=(0, 1))(rest, p["layers"])
+                loss, rest_g, layer_g = value_and_grad(
+                    rest, p["layers"], toks, tgts,
+                    opt_state.scaler.loss_scale)
                 rest_g, layer_g = reduce_nonzero(rest_g, layer_g)
                 return (collectives.pmean(loss, grad_axes),
                         rest_g, layer_g)

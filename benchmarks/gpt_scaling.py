@@ -53,22 +53,32 @@ from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 # (reduce_dtype="int8": encoded all_to_all + per-chunk fp32 scales +
 # error-feedback residual, parallel/quantize.py — the row's
 # comm_bytes_by_verb_dtype block shows the 1/4-bytes wire next to the
-# fp32 twin). Each marked config records its comm/static-hazard blocks
-# next to the plain twin so the decomposed-collective structure shows up
-# in scaling_table.json.
+# fp32 twin), "zb" = the zero-bubble schedule engine (schedules.
+# plan_schedule("zero-bubble") interpreted by schedule_grads_fn: explicit
+# W/B-split backward slots instead of the AD-transposed ring; the row's
+# timeline block carries the (S-1)/(3M+S-1) floor next to the 1f1b twin's
+# (S-1)/(M+S-1)). Each marked config records its comm/static-hazard
+# blocks next to the plain twin so the decomposed-collective structure
+# shows up in scaling_table.json.
 GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (8, 1, 1, 1, "zero-q8"),
         (8, 1, 1, 1, "zero3"), (4, 2, 1),
-        (4, 2, 1, 1, "sp"), (2, 1, 4), (1, 2, 4), (2, 1, 2, 2)]
+        (4, 2, 1, 1, "sp"), (2, 1, 4), (4, 1, 2, 1, "zb"),
+        (1, 2, 4), (2, 1, 2, 2)]
 
 
 def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
                micro_batch, n_micro, steps, sequence_parallel=False,
-               zero=False, zero_level=None, reduce_dtype=None):
+               zero=False, zero_level=None, reduce_dtype=None,
+               pp_schedule="1f1b"):
     n_dev = dp * tp * pp * cp
     if len(jax.devices()) < n_dev:
         return None
     zero_level = zero_level or (2 if zero or reduce_dtype else 0)
     zero = zero_level > 0
+    if pp_schedule == "zerobubble" and (tp > 1 or cp > 1 or zero or pp < 2):
+        raise ValueError(
+            "the zb grid row drives the pipe axis only (tp=1, cp=1, "
+            "zero off, pp>1)")
     mesh = mesh_lib.make_virtual_mesh(
         n_dev, tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp,
         context_parallel_size=cp)
@@ -102,14 +112,29 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         data_spec = P(mesh_lib.AXIS_DATA,
                       mesh_lib.AXIS_CONTEXT if cp > 1 else None)
 
+        zb_vg = None
+        if pp_schedule == "zerobubble":
+            # the zero-bubble schedule engine: explicit W/B-split backward
+            # slots via the plan executor, a drop-in for value_and_grad of
+            # the pipelined loss (pp-axis only, so the "zb" grid row runs
+            # tp=1)
+            from apex_tpu.transformer.pipeline_parallel import (
+                zero_bubble_grads_fn,
+            )
+
+            zb_vg = zero_bubble_grads_fn(model, n_micro, pp)
+
         def sharded_grads(p, toks, tgts, scale):
             rest = {k: v for k, v in p.items() if k != "layers"}
 
-            def scaled_loss(rest, layers):
-                return pipe_loss(rest, layers, toks, tgts) * scale
+            if zb_vg is not None:
+                loss, rg, lg = zb_vg(rest, p["layers"], toks, tgts, scale)
+            else:
+                def scaled_loss(rest, layers):
+                    return pipe_loss(rest, layers, toks, tgts) * scale
 
-            loss, (rg, lg) = jax.value_and_grad(scaled_loss, argnums=(0, 1))(
-                rest, p["layers"])
+                loss, (rg, lg) = jax.value_and_grad(
+                    scaled_loss, argnums=(0, 1))(rest, p["layers"])
             rg = allreduce_gradients_by_spec(rg, rest_specs)
             lg = allreduce_gradients(lg, grad_axes)
             return collectives.pmean(loss, grad_axes), dict(rg, layers=lg)
@@ -194,6 +219,8 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             conf["zero_level"] = zero_level
         if reduce_dtype:
             conf["reduce_dtype"] = reduce_dtype
+        if pp_schedule != "1f1b":
+            conf["pp_schedule"] = pp_schedule
         row = {
             "config": conf,
             "avg_iteration_time_s": round(dt, 4),
@@ -241,10 +268,13 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             # applies on the CPU virtual mesh.
             from apex_tpu.monitor import tracing as tracing_lib
 
+            tl_sched = ("zero-bubble" if pp_schedule == "zerobubble"
+                        else "interleaved")
             tl = {
+                "schedule": tl_sched,
                 "expected_bubble_fraction": round(
                     tracing_lib.expected_bubble_fraction(
-                        "interleaved", n_micro, pp), 4) if pp > 1 else 0.0,
+                        tl_sched, n_micro, pp), 4) if pp > 1 else 0.0,
             }
             flops = (row.get("mfu") or {}).get("achieved_tflops")
             tl["anatomy"] = tracing_lib.step_anatomy(
@@ -449,12 +479,14 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
         zero_level = (3 if "zero3" in marks
                       else 2 if "zero" in marks or reduce_dtype else 0)
         zero = zero_level > 0
+        pp_schedule = "zerobubble" if "zb" in marks else "1f1b"
         for layers in layers_list:
             res = run_config(
                 dp, tp, pp, cp, hidden=hidden, layers=layers, heads=heads,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
                 n_micro=n_micro, steps=steps, sequence_parallel=sp,
-                zero_level=zero_level, reduce_dtype=reduce_dtype)
+                zero_level=zero_level, reduce_dtype=reduce_dtype,
+                pp_schedule=pp_schedule)
             if res is None:
                 # not enough devices — no layer count will change that;
                 # record ONE skipped row for this config and move on
@@ -477,11 +509,13 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             # key set would make a later plain config look like its
             # duplicate and silently skip it
             defaults = {"cp": 1, "sequence_parallel": False, "zero": False,
-                        "zero_level": 0, "reduce_dtype": None}
+                        "zero_level": 0, "reduce_dtype": None,
+                        "pp_schedule": "1f1b"}
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
                         "sequence_parallel": sp and tp > 1, "zero": zero,
                         "zero_level": zero_level,
-                        "reduce_dtype": reduce_dtype, "layers": eff}
+                        "reduce_dtype": reduce_dtype,
+                        "pp_schedule": pp_schedule, "layers": eff}
             if any({k: r["config"].get(k, defaults.get(k, 1))
                     for k in base_cfg} == base_cfg
                    for r in rows):
@@ -503,6 +537,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                 cp_tag += ("_zero3" if zero_level >= 3
                            else "_zero_q8" if zero and reduce_dtype
                            else "_zero" if zero else "")
+                cp_tag += "_zb" if pp_schedule == "zerobubble" else ""
                 name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
                 with open(os.path.join(output_dir, name), "w") as f:
                     json.dump(res, f, indent=1)
@@ -530,7 +565,9 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
         sp_mark = ("sp" if c.get("sequence_parallel")
                    else "zero3" if c.get("zero_level", 0) >= 3
                    else "zeroq8" if c.get("zero") and c.get("reduce_dtype")
-                   else "zero" if c.get("zero") else "-")
+                   else "zero" if c.get("zero")
+                   else "zb" if c.get("pp_schedule") == "zerobubble"
+                   else "-")
         if c.get("placement_rung"):
             z3 = r["param_state_report"]["per_rank"]["zero3"]["total_bytes"]
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "

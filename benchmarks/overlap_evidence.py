@@ -926,6 +926,159 @@ def _timeline_main(args) -> int:
     finally:
         mesh_lib.destroy_model_parallel()
 
+    # -- schedule engine: measured zero-bubble vs 1F1B at the same (S, M) --
+    try:
+        from apex_tpu.transformer.pipeline_parallel import (
+            plan_schedule,
+            traced_schedule_timeline,
+        )
+
+        mesh = mesh_lib.make_virtual_mesh(
+            S, pipeline_model_parallel_size=S)
+        model = GPTModel(GPTConfig(axis=None, **tiny))
+        params = model.init(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                  tiny["vocab_size"])
+        tgt = jnp.roll(toks, -1, axis=-1)
+        layer_specs = pipeline_specs(model.specs()["layers"])
+        layers_plain = tp_mod.shard_params(params["layers"], layer_specs,
+                                           mesh)
+        rest = {k: v for k, v in params.items() if k != "layers"}
+        serial_loss = float(model.loss(params, toks, tgt))
+        sched_block = {}
+        for sched in ("1f1b", "zero-bubble"):
+            plan = plan_schedule(sched, M, S)
+            zloss, _, an = traced_schedule_timeline(
+                plan, mesh, embed=model.embed,
+                run_layers=lambda lp, h: model.run_layers(lp, h),
+                head_loss=lambda p, h, t: model.head(p, h, t),
+                rest_params=rest, layers=layers_plain,
+                layer_specs=layer_specs, batch=toks, targets=tgt,
+                tracer=tracer, step=10 if sched == "1f1b" else 11)
+            sched_block[sched] = {
+                "ticks": an["ticks"],
+                "measured_bubble": an["bubble_fraction"]["mean"],
+                "expected_bubble_fraction": an["expected_bubble_fraction"],
+                "plan_bubble_fraction": an["plan_bubble_fraction"],
+                "loss": round(float(zloss), 6),
+                "loss_matches_serial": bool(
+                    abs(float(zloss) - serial_loss) < 1e-4),
+            }
+        record["schedules"] = sched_block
+        zb = sched_block["zero-bubble"]
+        f1b = sched_block["1f1b"]
+        # the engine claim: the W/B-split planner's MEASURED bubble lands
+        # strictly below 1F1B's at the same (S, M) and approaches its own
+        # analytic floor (contended-container tolerance as above)
+        checks["zb_bubble_below_1f1b"] = bool(
+            zb["measured_bubble"] < f1b["measured_bubble"]
+            and zb["loss_matches_serial"] and f1b["loss_matches_serial"])
+        checks["zb_bubble_near_floor"] = bool(
+            abs(zb["measured_bubble"] - zb["expected_bubble_fraction"])
+            <= max(0.05, 0.5 * zb["expected_bubble_fraction"]))
+    except Exception as e:  # noqa: BLE001 - a negative result is a result
+        record["schedules_error"] = str(e)[:400]
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+    # -- ZeRO-3 gather prefetch: tripwire + wire-model overlap estimate ----
+    try:
+        from apex_tpu.lint.trace import unprefetched_gather_hazards
+        from apex_tpu.monitor import mfu as mfu_lib
+        from apex_tpu.monitor.comms import comm_accounting
+        from apex_tpu.optimizers.distributed import gather_chunked_tree
+
+        dp, L = 8, 4
+        pcfg = dict(vocab_size=128, hidden_size=32, num_layers=L,
+                    num_attention_heads=4, max_seq_len=16,
+                    hidden_dropout=0.0, axis=None,
+                    compute_dtype=jnp.bfloat16, unroll_layers=True)
+        policy = amp.get_policy("O2")
+        mp3 = amp.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-4), policy, zero_axis="data", zero_level=3,
+            gather_dtype="bf16")
+        pparams = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype),
+            jax.eval_shape(
+                lambda k: amp.cast_params(
+                    GPTModel(GPTConfig(**pcfg)).init(k), policy),
+                jax.random.PRNGKey(0)))
+        meta = mp3.zero3_meta(pparams)
+        layer_meta = meta.subtree("layers")
+        rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+        ptoks = jnp.zeros((2, 16), jnp.int32)
+
+        def z3_loss(prefetch):
+            pmodel = GPTModel(GPTConfig(zero3_prefetch=prefetch, **pcfg))
+
+            def fn(p):
+                chunks = mp3.zero3_shard(p)
+                rest = gather_chunked_tree(
+                    {k: v for k, v in chunks.items() if k != "layers"},
+                    rest_meta)
+                return pmodel.loss(
+                    dict(rest, layers=chunks["layers"]), ptoks, ptoks,
+                    layer_chunk_meta=layer_meta)
+            return fn
+
+        # compute seconds come from the SERIAL twin's grad flops (the
+        # gathers add no FLOPs and tracing it needs no axis binding)
+        serial_model = GPTModel(GPTConfig(**pcfg))
+        flops = mfu_lib.traced_step_costs(
+            jax.value_and_grad(
+                lambda p: serial_model.loss(p, ptoks, ptoks)),
+            pparams)["flops"]
+        pref_block = {}
+        for label, pf in (("serialized", 0), ("prefetched", 1)):
+            grad_fn = jax.value_and_grad(z3_loss(pf))
+            with comm_accounting() as acct:
+                jx = jax.make_jaxpr(grad_fn, axis_env=[("data", dp)])(
+                    pparams)
+            hz = unprefetched_gather_hazards(jx, zero_axis="data")
+            gather_bytes = sum(
+                r["bytes"] for r in acct.records
+                if r["axis"] == "data" and r["verb"] == "all_gather")
+            # wire-model structural estimate (the labelled-emulation
+            # caveat of the scaling table applies: CPU lowers collectives
+            # synchronously, so the OVERLAP win is argued from structure
+            # + the wire model, not a CPU wall measurement): per-layer
+            # gathers that stand free ahead of the compute hide under it
+            # (double-buffer pipeline: wall = first gather + L*max(c, g));
+            # remat-fused gathers serialize (wall = compute + comm)
+            ici_bw = tracing.ici_spec("tpu v5e")["ici_bytes_per_sec"]
+            peak = mfu_lib.PEAK_SPECS["v5e"][0]  # v5e bf16 peak
+            comm_s = gather_bytes / ici_bw
+            compute_s = flops / peak
+            c_l, g_l = compute_s / L, comm_s / L
+            if hz["hazard"]:
+                wall = compute_s + comm_s
+            else:
+                wall = g_l + L * max(c_l, g_l)
+            an = tracing.step_anatomy(
+                wall_s=wall, compute_s=compute_s, comm_s=comm_s)
+            pref_block[label] = {
+                "hazard": hz["hazard"],
+                "fused_gathers": hz["fused_gathers"],
+                "free_gathers": hz["free_gathers"],
+                "gather_bytes": int(gather_bytes),
+                "overlap_fraction": an.get("overlap_fraction", 0.0),
+                "anatomy": an,
+            }
+        pref_block["basis"] = (
+            "structural census (unprefetched_gather_hazards) x wire model "
+            "(ICI table / v5e peak): the overlap fraction is a modeled "
+            "number — the structure is the measured fact")
+        record["zero3_prefetch"] = pref_block
+        checks["prefetch_tripwire"] = bool(
+            pref_block["serialized"]["hazard"]
+            and not pref_block["prefetched"]["hazard"]
+            and pref_block["prefetched"]["free_gathers"] >= L)
+        checks["zero3_prefetch_overlap_rises"] = bool(
+            pref_block["prefetched"]["overlap_fraction"]
+            > pref_block["serialized"]["overlap_fraction"])
+    except Exception as e:  # noqa: BLE001
+        record["zero3_prefetch_error"] = str(e)[:400]
+
     # -- ZeRO / ZeRO-3 phase anatomy (traced two-program steps) ------------
     for lvl in (2, 3):
         key = f"zero{lvl}"
@@ -1032,7 +1185,9 @@ def _timeline_main(args) -> int:
 
     record["checks"] = {k: bool(v) for k, v in checks.items()}
     required = ("bubble_within_tolerance", "loss_matches_serial",
-                "untimed_tripwire", "zero2_fracs_sum_1",
+                "untimed_tripwire", "zb_bubble_below_1f1b",
+                "zb_bubble_near_floor", "prefetch_tripwire",
+                "zero3_prefetch_overlap_rises", "zero2_fracs_sum_1",
                 "zero3_fracs_sum_1", "chrome_export_loadable")
     record["ok"] = all(record["checks"].get(k) for k in required)
     print(json.dumps(record))

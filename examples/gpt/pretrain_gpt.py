@@ -63,6 +63,27 @@ def parse_args():
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--opt-level", default="O2")
+    p.add_argument("--pp-schedule", default="1f1b",
+                   choices=["gpipe", "1f1b", "interleaved", "zerobubble"],
+                   help="pipeline schedule (schedule-as-data planners, "
+                        "transformer/pipeline_parallel/schedules.py). "
+                        "gpipe|1f1b share the compiled SPMD ring (the "
+                        "AD-transposed drain IS 1F1B's cooldown); "
+                        "interleaved adds vpp virtual chunks per stage "
+                        "(--vpp); zerobubble drives the explicit W/B-split "
+                        "executor (schedule_grads_fn: bwd_weight slots of "
+                        "early microbatches fill the cooldown — needs "
+                        "pp>1, tp=1, zero level < 3)")
+    p.add_argument("--vpp", type=int, default=None,
+                   help="virtual pipeline chunks per stage for "
+                        "--pp-schedule interleaved (default 2 there, "
+                        "1 otherwise); layers are interleave_stack-"
+                        "permuted, checkpoints store that order")
+    p.add_argument("--zero3-prefetch", type=int, default=0, metavar="N",
+                   help="double-buffer the ZeRO-3 per-layer chunk "
+                        "all-gathers N layers ahead (forward and backward "
+                        "re-gathers; needs --zero-level 3 and --unroll — "
+                        "models/_transformer._prefetched_zero3_drive)")
     p.add_argument("--unroll", action="store_true",
                    help="drive the layer stack with static slices instead "
                         "of lax.scan (kills the scan backward's grad "
@@ -115,6 +136,27 @@ def parse_args():
     if args.reduce_dtype and not args.zero:
         p.error("--reduce-dtype requires --zero (it is the ZeRO grad "
                 "reduce-scatter wire dtype)")
+    if args.vpp is None:
+        args.vpp = 2 if args.pp_schedule == "interleaved" else 1
+    if args.vpp > 1 and args.pp_schedule != "interleaved":
+        p.error("--vpp > 1 is the interleaved schedule's knob")
+    if args.pp_schedule == "interleaved" and args.vpp < 2:
+        p.error("--pp-schedule interleaved needs --vpp >= 2")
+    if args.pp_schedule == "zerobubble":
+        if args.pp < 2 or args.tp > 1:
+            p.error("--pp-schedule zerobubble needs --pp >= 2 and --tp 1 "
+                    "(the explicit-backward executor drives the pipe axis "
+                    "only)")
+        if (args.zero_level or 0) >= 3:
+            p.error("--pp-schedule zerobubble composes with ZeRO levels "
+                    "1/2 only (level 3 rebuilds the pipelined loss)")
+    if args.zero3_prefetch:
+        if (args.zero_level or 0) < 3:
+            p.error("--zero3-prefetch requires --zero-level 3 (it "
+                    "double-buffers the per-layer chunk gathers)")
+        if not args.unroll:
+            p.error("--zero3-prefetch requires --unroll (the prefetch "
+                    "schedule is a static unrolled structure)")
     return args
 
 
@@ -128,7 +170,7 @@ def main():
         pipeline_model_parallel_size=args.pp,
     )
     dp = mesh_lib.get_data_parallel_world_size()
-    assert args.layers % max(args.pp, 1) == 0
+    assert args.layers % max(args.pp * args.vpp, 1) == 0
 
     cfg = GPTConfig(
         vocab_size=args.vocab,
@@ -141,6 +183,7 @@ def main():
         compute_dtype=jnp.bfloat16 if args.opt_level in ("O1", "O2", "O3") else jnp.float32,
         remat=True,
         unroll_layers=args.unroll,
+        zero3_prefetch=args.zero3_prefetch,
     )
     model = GPTModel(cfg)
     policy = amp.get_policy(args.opt_level)
@@ -162,6 +205,16 @@ def main():
         {k: v for k, v in all_specs.items() if k != "layers"},
         layers=pipeline_specs(all_specs["layers"]),
     )
+    if args.vpp > 1:
+        # interleaved chunk placement: stage s chunk c holds serial slab
+        # c*pp + s; training/checkpointing in this order is
+        # self-consistent (schedules.interleave_stack)
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            interleave_stack,
+        )
+
+        full = dict(full, layers=interleave_stack(
+            full["layers"], args.pp, args.vpp))
     params = tp_mod.shard_params(full, specs, mesh)
 
     tracer = None
@@ -182,16 +235,40 @@ def main():
         run_layers=lambda lp, h: model.run_layers(lp, h),
         head_loss=lambda p, h, t: model.head(p, h, t),
         num_microbatches=args.num_microbatches,
+        virtual_pipeline_size=args.vpp,
     )
+    zb_vg = None
+    if args.pp_schedule == "zerobubble":
+        # schedule-as-data: the zero-bubble plan (W/B-split backward
+        # slots) interpreted by the compiled executor, a drop-in for
+        # value_and_grad of the pipelined loss
+        from apex_tpu.transformer.pipeline_parallel import (
+            plan_schedule,
+            zero_bubble_grads_fn,
+        )
+
+        zb_plan = plan_schedule("zero-bubble", args.num_microbatches,
+                                args.pp)
+        zb_vg = zero_bubble_grads_fn(model, args.num_microbatches, args.pp)
+        from apex_tpu.monitor.tracing import expected_bubble_fraction
+
+        print(f"pp-schedule zerobubble: {zb_plan.ticks} ticks, "
+              f"{zb_plan.idle_slots()[0]} idle/rank (analytic bubble "
+              f"{expected_bubble_fraction('zero-bubble', args.num_microbatches, args.pp):.4f} "
+              f"vs 1f1b "
+              f"{expected_bubble_fraction('1f1b', args.num_microbatches, args.pp):.4f})")
 
     def sharded_grads(p, toks, tgts, scale):
         rest = {k: v for k, v in p.items() if k != "layers"}
+        if zb_vg is not None:
+            loss, rest_g, layer_g = zb_vg(rest, p["layers"], toks, tgts,
+                                          scale)
+        else:
+            def scaled_loss(rest, layers):
+                return pipe_loss(rest, layers, toks, tgts) * scale
 
-        def scaled_loss(rest, layers):
-            return pipe_loss(rest, layers, toks, tgts) * scale
-
-        loss, (rest_g, layer_g) = jax.value_and_grad(scaled_loss, argnums=(0, 1))(
-            rest, p["layers"])
+            loss, (rest_g, layer_g) = jax.value_and_grad(
+                scaled_loss, argnums=(0, 1))(rest, p["layers"])
         rest_g = allreduce_gradients_by_spec(rest_g, rest_specs)
         layer_g = allreduce_gradients(layer_g, grad_axes)
         return collectives.pmean(loss, grad_axes), dict(rest_g, layers=layer_g)
@@ -222,6 +299,10 @@ def main():
                 data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA,
                 zero3=z3, model=model,
                 num_microbatches=args.num_microbatches,
+                # the layer stack is interleave_stack-permuted when
+                # vpp > 1: the rebuilt pipelined loss must drive it with
+                # the same chunk placement
+                virtual_pipeline_size=args.vpp,
                 traced=bool(args.trace), tracer=tracer)
         else:
             opt_state, state_specs = mp_opt.zero_init(params, mesh, specs)
@@ -229,7 +310,8 @@ def main():
                 mp_opt, mesh, specs, state_specs, pipe_loss,
                 rest_specs=rest_specs, grad_axes=grad_axes,
                 data_spec=data_spec, zero_axis=mesh_lib.AXIS_DATA,
-                traced=bool(args.trace), tracer=tracer)
+                traced=bool(args.trace), tracer=tracer,
+                pipe_value_and_grad=zb_vg)
     else:
         opt_state = mp_opt.init(params)
         shard_fn = jax.shard_map(
@@ -333,27 +415,45 @@ def main():
     if (args.trace and args.pp > 1 and args.tp == 1
             and (args.zero_level or 0) < 3):
         # measure the pipeline's per-rank bubble fraction for real: one
-        # tick-by-tick traced drive of the SAME ring (schedules.
-        # traced_pipeline_timeline), spans into the trace file, the
+        # tick-by-tick traced drive of the SELECTED schedule (the ring
+        # drive for interleaved/vpp; the plan executor for the vpp=1
+        # planners incl. zerobubble), spans into the trace file, the
         # measured-vs-analytic stamp into every journal record
         try:
             from apex_tpu.monitor import tracing as tracing_mod
             from apex_tpu.transformer.pipeline_parallel import (
+                plan_schedule,
                 traced_pipeline_timeline,
+                traced_schedule_timeline,
             )
 
             probe_rows = args.micro_batch * args.num_microbatches
             ptoks = jnp.zeros((probe_rows, args.seq), jnp.int32)
-            _, _, anatomy = traced_pipeline_timeline(
-                mesh, embed=model.embed,
-                run_layers=lambda lp, h: model.run_layers(lp, h),
-                head_loss=lambda p, h, t: model.head(p, h, t),
-                rest_params={k: v for k, v in params.items()
-                             if k != "layers"},
-                layers=params["layers"], layer_specs=specs["layers"],
-                batch=ptoks, targets=ptoks,
-                num_microbatches=args.num_microbatches,
-                tracer=tracer, step=-1)
+            if args.pp_schedule == "interleaved":
+                _, _, anatomy = traced_pipeline_timeline(
+                    mesh, embed=model.embed,
+                    run_layers=lambda lp, h: model.run_layers(lp, h),
+                    head_loss=lambda p, h, t: model.head(p, h, t),
+                    rest_params={k: v for k, v in params.items()
+                                 if k != "layers"},
+                    layers=params["layers"], layer_specs=specs["layers"],
+                    batch=ptoks, targets=ptoks,
+                    num_microbatches=args.num_microbatches,
+                    virtual_pipeline_size=args.vpp,
+                    tracer=tracer, step=-1)
+            else:
+                probe_plan = plan_schedule(
+                    "zero-bubble" if args.pp_schedule == "zerobubble"
+                    else args.pp_schedule,
+                    args.num_microbatches, args.pp)
+                _, _, anatomy = traced_schedule_timeline(
+                    probe_plan, mesh, embed=model.embed,
+                    run_layers=lambda lp, h: model.run_layers(lp, h),
+                    head_loss=lambda p, h, t: model.head(p, h, t),
+                    rest_params={k: v for k, v in params.items()
+                                 if k != "layers"},
+                    layers=params["layers"], layer_specs=specs["layers"],
+                    batch=ptoks, targets=ptoks, tracer=tracer, step=-1)
             print(f"measured bubble fraction "
                   f"{anatomy['bubble_fraction']['mean']} "
                   f"(analytic floor {anatomy['expected_bubble_fraction']})")

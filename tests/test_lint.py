@@ -689,6 +689,100 @@ def test_zero3_gather_on_real_gpt_step():
 
 
 # ---------------------------------------------------------------------------
+# engine 2: ZeRO-3 gather-prefetch tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_unprefetched_gather_flags_remat_fused_gathers():
+    """Per-layer gathers INSIDE rematerialized bodies (the serialized
+    unrolled ZeRO-3 drive) are pinned to their layer's schedule — the
+    hazard; free-standing gathers issued ahead of the compute (the
+    double-buffered drive's structure) pass."""
+    import jax
+
+    from apex_tpu.optimizers.distributed import gather_leaf
+
+    row = (16, 16)
+    chunks = jnp.ones((4, 32), jnp.float32)  # 4 layers, k=32 at n=8
+    h0 = jnp.ones((2, 16), jnp.float32)
+
+    def serialized(c, h):
+        for i in range(4):
+            body = jax.checkpoint(
+                lambda ci, hh: jnp.tanh(
+                    hh @ gather_leaf(ci, row, jnp.float32, "data")))
+            h = body(c[i], h)
+        return jnp.sum(h * h)
+
+    def prefetched(c, h):
+        gathered = [gather_leaf(c[i], row, jnp.float32, "data")
+                    for i in range(4)]
+        for p in gathered:
+            h = jnp.tanh(h @ p)
+        return jnp.sum(h * h)
+
+    bad = trace.unprefetched_gather_hazards(
+        jax.grad(serialized, argnums=0), chunks, h0, axes={"data": 8})
+    assert bad["hazard"] and bad["fused_gathers"] >= 2, bad
+    assert bad["findings"][0]["rule"] == "unprefetched-gather"
+    ok = trace.unprefetched_gather_hazards(
+        jax.grad(prefetched, argnums=0), chunks, h0, axes={"data": 8})
+    assert not ok["hazard"] and ok["free_gathers"] >= 4, ok
+
+
+def test_unprefetched_gather_on_real_zero3_step():
+    """Both ways on the REAL drives: the serialized unrolled chunk_meta
+    step (zero3_prefetch=0) flags; the double-buffered drive
+    (zero3_prefetch=1, models/_transformer._prefetched_zero3_drive)
+    traces clean with its gathers free — and still passes the bulk-gather
+    tripwire (per-layer gathers only)."""
+    import jax
+
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.distributed import gather_chunked_tree
+
+    base = dict(vocab_size=64, hidden_size=16, num_layers=4,
+                num_attention_heads=2, max_seq_len=8, hidden_dropout=0.0,
+                axis=None, unroll_layers=True)
+    params = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype),
+        jax.eval_shape(GPTModel(GPTConfig(**base)).init,
+                       jax.random.PRNGKey(0)))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-3), amp.get_policy("O2"),
+        zero_axis="data", zero_level=3)
+    meta = mp_opt.zero3_meta(params)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jnp.zeros((2, 8), jnp.int32)
+
+    def loss_fn(prefetch):
+        model = GPTModel(GPTConfig(zero3_prefetch=prefetch, **base))
+
+        def fn(p):
+            chunks = mp_opt.zero3_shard(p)
+            rest = gather_chunked_tree(
+                {k: v for k, v in chunks.items() if k != "layers"},
+                rest_meta)
+            return model.loss(dict(rest, layers=chunks["layers"]),
+                              toks, toks, layer_chunk_meta=layer_meta)
+        return fn
+
+    bad = trace.unprefetched_gather_hazards(
+        jax.value_and_grad(loss_fn(0)), params, axes={"data": 8})
+    assert bad["hazard"] and bad["fused_gathers"] >= 2, bad
+    jx = jax.make_jaxpr(jax.value_and_grad(loss_fn(1)),
+                        axis_env=[("data", 8)])(params)
+    ok = trace.unprefetched_gather_hazards(jx)
+    assert not ok["hazard"] and ok["free_gathers"] >= 4, ok
+    # the prefetched drive must not regress the bulk-gather tripwire
+    bulk = trace.zero3_gather_hazards(jx, min_model_elems=4096)
+    assert not bulk["hazard"], bulk
+
+
+# ---------------------------------------------------------------------------
 # engine 2: quantized-collective tripwire
 # ---------------------------------------------------------------------------
 

@@ -256,6 +256,52 @@ def test_analyze_timeline_section():
         [_step(i, 100.0 + i) for i in range(4)])
 
 
+def test_compare_overlap_threshold_gate():
+    """The comm/compute overlap fraction gates like throughput (higher is
+    better, so must_not_drop) — the machine gate for the ZeRO-3
+    double-buffered gather work."""
+    a = [_step(i, 100.0 + i, overlap_fraction=0.60) for i in range(6)]
+    worse = [_step(i, 100.0 + i, overlap_fraction=0.40) for i in range(6)]
+    res = report.compare(a, worse, overlap_threshold=0.10)
+    assert "overlap_fraction_p50" in res["regressed"]
+    # within tolerance: ok
+    near = [_step(i, 100.0 + i, overlap_fraction=0.57) for i in range(6)]
+    assert report.compare(a, near, overlap_threshold=0.10)["ok"]
+    # defaults to --threshold when unset
+    res2 = report.compare(a, worse, threshold=0.05)
+    assert "overlap_fraction_p50" in res2["regressed"]
+    # a HIGHER overlap (the prefetch-improvement direction) never
+    # regresses, and absent stamps skip the check
+    better = [_step(i, 100.0 + i, overlap_fraction=0.90) for i in range(6)]
+    assert report.compare(a, better)["ok"]
+    plain = [_step(i, 100.0 + i) for i in range(6)]
+    res3 = report.compare(plain, plain, overlap_threshold=0.10)
+    assert "overlap_fraction_p50" not in [c["check"] for c in res3["checks"]]
+    # CLI surface
+    import os
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="apex_tpu_overlap_gate_")
+    try:
+        pa, pb = os.path.join(d, "a.jsonl"), os.path.join(d, "b.jsonl")
+        for path, rows in ((pa, a), (pb, worse)):
+            with open(path, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+        import contextlib
+        import io
+
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert report.main(
+                ["compare", pa, pb, "--overlap-threshold", "0.10"]) == 1
+            assert report.main(
+                ["compare", pa, pa, "--overlap-threshold", "0.10"]) == 0
+    finally:
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def test_compare_bubble_threshold_gate():
     a = [_step(i, 100.0 + i, bubble_fraction=0.20) for i in range(6)]
     worse = [_step(i, 100.0 + i, bubble_fraction=0.30) for i in range(6)]

@@ -104,17 +104,94 @@ def test_scoped_arming_restores_previous_state():
 
 
 def test_expected_bubble_fraction_known_points():
+    """Hand-computed floors for all four planners: gpipe/1f1b share
+    (S-1)/(M+S-1), interleaved divides the live slots by vpp, and the
+    zero-bubble W/B split lands at (S-1)/(3M+S-1) — 3M live slots per
+    rank, only the S-1 fill ticks idle."""
     ebf = tracing.expected_bubble_fraction
     assert math.isclose(ebf("gpipe", 8, 4), 3 / 11)
     assert math.isclose(ebf("1f1b", 8, 4), 3 / 11)
     assert math.isclose(ebf("interleaved", 8, 4, 2), 3 / 19)
     assert math.isclose(ebf("interleaved", 4, 4, 1), 3 / 7)
-    assert ebf("zero-bubble", 8, 4) == 0.0
+    # zero-bubble hand points: S=4,M=8 -> 3/27; S=2,M=4 -> 1/13; S=3,M=3
+    # -> 2/11 — each strictly below the 1f1b floor at the same (S, M)
+    assert math.isclose(ebf("zero-bubble", 8, 4), 3 / 27)
+    assert math.isclose(ebf("zero-bubble", 4, 2), 1 / 13)
+    assert math.isclose(ebf("zero-bubble", 3, 3), 2 / 11)
+    for M, S in ((8, 4), (4, 2), (3, 3)):
+        assert ebf("zero-bubble", M, S) < ebf("1f1b", M, S)
     assert ebf("1f1b", 8, 1) == 0.0  # no pipeline, no bubble
+    assert ebf("zero-bubble", 8, 1) == 0.0
     with pytest.raises(ValueError):
         ebf("mystery", 8, 4)
     with pytest.raises(ValueError):
         ebf("1f1b", 0, 4)
+
+
+def test_schedule_plans_meet_closed_form_floors():
+    """Schedule-as-data pinning: the greedy planners' COUNTED idle
+    fractions equal the closed-form floors at every tested (S, M), and
+    the interleaved plan mirrors the ring algebra's tick count."""
+    from apex_tpu.transformer.pipeline_parallel import plan_schedule
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pipeline_tick_count,
+    )
+
+    for sched in ("gpipe", "1f1b", "zero-bubble"):
+        for S in (2, 3, 4):
+            for M in (S, 4, 8):
+                if M < S:
+                    continue
+                plan = plan_schedule(sched, M, S)
+                floor = tracing.expected_bubble_fraction(sched, M, S)
+                assert math.isclose(plan.bubble_fraction(), floor), (
+                    sched, S, M, plan.bubble_fraction(), floor)
+                want_ticks = (3 * M + S - 1 if sched == "zero-bubble"
+                              else 2 * (M + S - 1))
+                assert plan.ticks == want_ticks, (sched, S, M, plan.ticks)
+    for vpp in (1, 2):
+        plan = plan_schedule("interleaved", 4, 4, vpp)
+        assert plan.ticks == 2 * pipeline_tick_count(4, 4, vpp)
+        assert math.isclose(
+            plan.bubble_fraction(),
+            tracing.expected_bubble_fraction("interleaved", 4, 4, vpp))
+
+
+def test_schedule_plan_dependencies_and_counts():
+    """Replay each plan against the pipeline dependency graph: every
+    (rank, microbatch) does each of its slot kinds exactly once, forwards
+    arrive only after the upstream rank's forward, input-grads only after
+    the downstream rank's, weight-grads only after the rank's own
+    input-grad — the W/B factoring's soundness condition."""
+    from apex_tpu.transformer.pipeline_parallel import plan_schedule
+
+    for sched in ("gpipe", "1f1b", "zero-bubble"):
+        S, M = 3, 4
+        plan = plan_schedule(sched, M, S)
+        done = {}  # (kind, s, m) -> tick
+        for t in range(plan.ticks):
+            for s in range(S):
+                sl = plan.ranks[s][t]
+                if sl.kind == "idle":
+                    continue
+                key = (sl.kind, s, sl.microbatch)
+                assert key not in done, key
+                done[key] = t
+                m = sl.microbatch
+                if sl.kind == "fwd" and s > 0:
+                    assert done[("fwd", s - 1, m)] < t, (sched, key)
+                if sl.kind in ("bwd", "bwd_input"):
+                    assert done[("fwd", s, m)] < t, (sched, key)
+                    if s < S - 1:
+                        assert done[(sl.kind, s + 1, m)] < t, (sched, key)
+                if sl.kind == "bwd_weight":
+                    assert done[("bwd_input", s, m)] < t, (sched, key)
+        kinds = (("fwd", "bwd_input", "bwd_weight")
+                 if sched == "zero-bubble" else ("fwd", "bwd"))
+        for k in kinds:
+            for s in range(S):
+                for m in range(M):
+                    assert (k, s, m) in done, (sched, k, s, m)
 
 
 def test_step_anatomy_fractions_sum_to_one():
@@ -309,6 +386,63 @@ def test_traced_drive_matches_serial_and_measures_bubble():
         pa = tracing.pipeline_anatomy(tr.records)
         assert pa["bubble_fraction"]["mean"] == pytest.approx(
             measured, abs=1e-6)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_traced_schedule_timeline_zero_bubble_beats_1f1b():
+    """The plan executor's measured drive: loss AND grads equal the
+    serial model for BOTH the 1f1b and zero-bubble plans, and the
+    zero-bubble W/B split's measured bubble lands strictly below 1f1b's
+    at the same (S, M), near its own floor."""
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer.pipeline_parallel import (
+        plan_schedule,
+        traced_schedule_timeline,
+    )
+
+    S, M = 2, 4
+    mesh, model, params, rest, layers_sh, layer_specs, toks, tgt = (
+        _drive_setup(S, 1))
+    try:
+        sl, sg = jax.value_and_grad(
+            lambda p: model.loss(p, toks, tgt))(params)
+        measured = {}
+        for sched in ("1f1b", "zero-bubble"):
+            tr = tracing.Tracer(None)
+            plan = plan_schedule(sched, M, S)
+            loss, grads, anatomy = traced_schedule_timeline(
+                plan, mesh, embed=model.embed,
+                run_layers=lambda lp, h: model.run_layers(lp, h),
+                head_loss=lambda p, h, t: model.head(p, h, t),
+                rest_params=rest, layers=layers_sh,
+                layer_specs=layer_specs, batch=toks, targets=tgt,
+                tracer=tr, step=0)
+            assert abs(float(loss) - float(sl)) < 1e-5, sched
+            for a, b in zip(jax.tree.leaves(grads["layers"]),
+                            jax.tree.leaves(sg["layers"])):
+                np.testing.assert_allclose(a, b, atol=1e-5)
+            for k in rest:
+                for a, b in zip(jax.tree.leaves(grads[k]),
+                                jax.tree.leaves(sg[k])):
+                    np.testing.assert_allclose(
+                        np.asarray(a, np.float32), b, atol=1e-5)
+            floor = anatomy["expected_bubble_fraction"]
+            mean = anatomy["bubble_fraction"]["mean"]
+            # the plan's counted floor must match the closed form, and
+            # the measurement must approach it (contended-CI tolerance)
+            assert math.isclose(
+                anatomy["plan_bubble_fraction"],
+                tracing.expected_bubble_fraction(sched, M, S),
+                abs_tol=1e-4)
+            assert abs(mean - floor) <= max(0.06, 0.5 * floor), anatomy
+            # W/B spans land as bwd slots with the wb attr
+            if sched == "zero-bubble":
+                wb = {r.get("wb") for r in tr.records
+                      if r.get("cat") == "pipe" and r.get("wb")}
+                assert wb == {"B", "W"}, wb
+            measured[sched] = mean
+        assert measured["zero-bubble"] < measured["1f1b"], measured
     finally:
         mesh_lib.destroy_model_parallel()
 

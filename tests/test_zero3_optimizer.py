@@ -389,3 +389,83 @@ def test_zero3_hybrid_tp_pp_dp():
         np.testing.assert_allclose(losses_for(3), l_repl, rtol=2e-3)
     finally:
         mesh_lib.destroy_model_parallel()
+
+
+@pytest.mark.parametrize("prefetch", [1, 2])
+def test_zero3_prefetch_matches_serialized_drive(prefetch):
+    """The double-buffered gather drive (zero3_prefetch > 0,
+    models/_transformer._prefetched_zero3_drive) computes the SAME loss
+    and grads as the serialized in-body-gather drive: the custom VJP's
+    backward re-gathers through jax.vjp of the same gather (so chunk
+    grads still arrive reduce-scattered) and rematerializes each layer —
+    only the issue ORDER of the collectives changes. Exercised under a
+    vmapped data axis (dp=8) so every gather/scatter runs for real."""
+    DPV = 8
+    base = dict(vocab_size=128, hidden_size=32, num_layers=4,
+                num_attention_heads=4, max_seq_len=16, hidden_dropout=0.0,
+                axis=None, compute_dtype=jnp.float32, unroll_layers=True)
+    policy = amp.get_policy("O0")
+    params = amp.cast_params(
+        GPTModel(GPTConfig(**base)).init(jax.random.PRNGKey(0)), policy)
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-4), policy, zero_axis="data", zero_level=3)
+    meta = mp_opt.zero3_meta(params)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+
+    def loss_fn(pf):
+        model = GPTModel(GPTConfig(zero3_prefetch=pf, **base))
+
+        def fn(p):
+            chunks = mp_opt.zero3_shard(p)
+            rest = gather_chunked_tree(
+                {k: v for k, v in chunks.items() if k != "layers"},
+                rest_meta)
+            return model.loss(dict(rest, layers=chunks["layers"]),
+                              toks, toks, layer_chunk_meta=layer_meta)
+        return fn
+
+    pbatch = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (DPV,) + x.shape), params)
+    l0, g0 = jax.jit(jax.vmap(jax.value_and_grad(loss_fn(0)),
+                              axis_name="data"))(pbatch)
+    l1, g1 = jax.jit(jax.vmap(jax.value_and_grad(loss_fn(prefetch)),
+                              axis_name="data"))(pbatch)
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_zero3_prefetch_validation():
+    """The prefetch drive's guardrails fail loudly: scan drive, aux
+    layers, and dropout/bias are named, not silently ignored."""
+    base = dict(vocab_size=64, hidden_size=16, num_layers=2,
+                num_attention_heads=2, max_seq_len=8, hidden_dropout=0.0,
+                axis=None, compute_dtype=jnp.float32)
+    model = GPTModel(GPTConfig(unroll_layers=False, zero3_prefetch=1,
+                               **base))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-4), amp.get_policy("O0"),
+        zero_axis="data", zero_level=3)
+    params = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype),
+        jax.eval_shape(model.init, jax.random.PRNGKey(0)))
+    meta = mp_opt.zero3_meta(params)
+    layer_meta = meta.subtree("layers")
+    chunks = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype),
+        jax.eval_shape(
+            lambda p: jax.vmap(mp_opt.zero3_shard,
+                               axis_name="data")(
+                jax.tree.map(lambda x: x[None], p)), params))
+    h = jnp.zeros((1, 2, 8, 16), jnp.float32)
+    with pytest.raises(ValueError, match="unroll_layers"):
+        jax.eval_shape(
+            lambda c, hh: jax.vmap(
+                lambda ci, hi: model.run_layers(
+                    ci, hi, chunk_meta=layer_meta),
+                axis_name="data")(c, hh),
+            chunks["layers"], h)
