@@ -60,7 +60,10 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   decode step whose jit signature DRIFTS across ticks (growing per-request
   KV shapes, python-int position/tick leaks): one recompile per generated
   token, the latency cliff the paged cache + fixed slot arrays exist to
-  prevent (apex_tpu/serve/engine.py).
+  prevent (apex_tpu/serve/engine.py). ``extra_streams`` audits the chunked
+  -prefill and speculative-verify programs' tick argument streams by the
+  same rules (a growing chunk count or python-int draft length = one
+  recompile per request).
 
 All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
 device work) and return plain dicts/lists of findings shaped like engine
@@ -920,26 +923,11 @@ def recompile_hazards(*args, **kwargs) -> List[Dict[str, Any]]:
     return findings
 
 
-def decode_recompile_hazards(step_args_fn, ticks: int = 3) -> Dict[str, Any]:
-    """Verify a serving decode step's jit signature is SHAPE-STABLE across
-    ticks — the decode-recompile tripwire.
-
-    ``step_args_fn(t)`` must return the exact argument pytree the jitted
-    decode step would receive at tick ``t`` (``apex_tpu.serve.Engine.
-    decode_args``). The engine's whole design contract is that every tick
-    compiles once: a per-request KV tensor that grows with the sequence, a
-    python-int position/tick, or a weak-typed leaf makes XLA recompile PER
-    TOKEN — the latency cliff this scanner names before the first tick
-    runs (``monitor.diagnose.RecompileTracker`` counts it after the fact).
-
-    Findings: ``decode-shape-churn`` (a leaf's shape/dtype/weak-type
-    differs between ticks — e.g. contiguous per-request KV instead of the
-    paged pool), ``decode-structure-churn`` (the pytree itself changes),
-    plus tick-0 :func:`recompile_hazards` findings (python scalars /
-    weak types in the signature). Host-side only; nothing is compiled.
-
-    Returns ``{hazard, findings, ticks, leaves}``.
-    """
+def _audit_arg_stream(step_args_fn, ticks: int, stream: str,
+                      findings: List[Dict[str, Any]]) -> int:
+    """Audit ONE jitted serving program's per-tick argument stream for
+    signature churn (the shared body of :func:`decode_recompile_hazards`).
+    Appends findings tagged with ``stream``; returns the leaf count."""
     from jax.tree_util import keystr, tree_flatten_with_path
 
     def signature(tree):
@@ -952,20 +940,20 @@ def decode_recompile_hazards(step_args_fn, ticks: int = 3) -> Dict[str, Any]:
             out.append((keystr(path), shape, dtype, weak))
         return out
 
-    findings: List[Dict[str, Any]] = []
     base = None
     for t in range(int(ticks)):
         args = step_args_fn(t)
         if t == 0:
-            findings.extend(recompile_hazards(args))
+            for f in recompile_hazards(args):
+                findings.append(dict(f, stream=stream))
             base = signature(args)
             continue
         sig = signature(args)
         if [s[0] for s in sig] != [s[0] for s in base]:
             findings.append({
-                "rule": "decode-structure-churn",
+                "rule": "decode-structure-churn", "stream": stream,
                 "message": (
-                    f"decode args pytree STRUCTURE changed between tick 0 "
+                    f"{stream} args pytree STRUCTURE changed between tick 0 "
                     f"and tick {t} ({len(base)} vs {len(sig)} leaves) -- "
                     f"every tick must ship the same tree (fixed max_batch "
                     f"slot arrays, the paged pool; serve/engine.py)"),
@@ -976,17 +964,57 @@ def decode_recompile_hazards(step_args_fn, ticks: int = 3) -> Dict[str, Any]:
                 continue
             findings.append({
                 "rule": "decode-shape-churn",
-                "where": where,
+                "where": where, "stream": stream,
                 "message": (
-                    f"decode arg {where} changed from {s0}/{d0}"
+                    f"{stream} arg {where} changed from {s0}/{d0}"
                     f"{'/weak' if w0 else ''} at tick 0 to {shape}/{dtype}"
                     f"{'/weak' if weak else ''} at tick {t} -- a fresh jit "
                     f"signature (and a recompile) per tick; per-request KV "
-                    f"must live in the fixed paged pool and positions must "
+                    f"must live in the fixed paged pool, chunk/draft counts "
+                    f"must be static program dimensions, and positions must "
                     f"be committed int32 arrays (serve/cache.py)"),
             })
+    return len(base or [])
+
+
+def decode_recompile_hazards(step_args_fn, ticks: int = 3,
+                             extra_streams=None) -> Dict[str, Any]:
+    """Verify a serving decode step's jit signature is SHAPE-STABLE across
+    ticks — the decode-recompile tripwire.
+
+    ``step_args_fn(t)`` must return the exact argument pytree the jitted
+    decode step would receive at tick ``t`` (``apex_tpu.serve.Engine.
+    decode_args``). The engine's whole design contract is that every tick
+    compiles once: a per-request KV tensor that grows with the sequence, a
+    python-int position/tick, or a weak-typed leaf makes XLA recompile PER
+    TOKEN — the latency cliff this scanner names before the first tick
+    runs (``monitor.diagnose.RecompileTracker`` counts it after the fact).
+
+    ``extra_streams`` (ISSUE 12) audits the OTHER serving programs' tick
+    argument streams by the same rules: a dict of ``name -> args_fn`` —
+    the engine exposes ``chunk_args`` (chunked prefill: a growing chunk
+    count would recompile per request) and ``spec_args`` (speculative
+    verify: a python-int draft length would recompile per tick). Their
+    findings carry ``stream=name``; per-stream leaf counts land in
+    ``stream_leaves``.
+
+    Findings: ``decode-shape-churn`` (a leaf's shape/dtype/weak-type
+    differs between ticks — e.g. contiguous per-request KV instead of the
+    paged pool), ``decode-structure-churn`` (the pytree itself changes),
+    plus tick-0 :func:`recompile_hazards` findings (python scalars /
+    weak types in the signature). Host-side only; nothing is compiled.
+
+    Returns ``{hazard, findings, ticks, leaves, stream_leaves}``.
+    """
+    findings: List[Dict[str, Any]] = []
+    leaves = _audit_arg_stream(step_args_fn, ticks, "decode", findings)
+    stream_leaves = {"decode": leaves}
+    for name, fn in (extra_streams or {}).items():
+        stream_leaves[str(name)] = _audit_arg_stream(
+            fn, ticks, str(name), findings)
     return {"hazard": bool(findings), "findings": findings,
-            "ticks": int(ticks), "leaves": len(base or [])}
+            "ticks": int(ticks), "leaves": leaves,
+            "stream_leaves": stream_leaves}
 
 
 # ---------------------------------------------------------------------------

@@ -444,6 +444,46 @@ class GPTModel(TransformerBase):
         h, (kps, vps) = lax.scan(body, h, (layers, k_pages, v_pages))
         return h, kps, vps
 
+    def serve_layers_multi(self, layers: Params, h: jax.Array,
+                           k_pages: jax.Array, v_pages: jax.Array,
+                           block_tables: jax.Array, write_flat: jax.Array,
+                           attend_lengths: jax.Array,
+                           positions: jax.Array):
+        """K-token sibling of :meth:`serve_layers_decode`: per layer, write
+        K new tokens' k/v heads per slot into the paged cache (``write_flat``
+        ``(b, K)`` flat row indices; masked rows point at the null page),
+        then K-query flash-decode over the pages with TRAILING-query
+        semantics (``attend_lengths[b]`` = keys visible to the FINAL query;
+        query ``j`` sees ``attend_lengths[b] - (K-1-j)`` — in-chunk
+        causality by length arithmetic). ``h`` is ``(b, K, hidden)``,
+        ``positions`` ``(b, K)``. Drives both chunked prefill (one slot, K
+        = chunk) and speculative verify (every slot, K = drafts + 1) from
+        the same compiled structure."""
+        from apex_tpu.ops.flash_decode import flash_decode_multi
+
+        c = self.cfg
+
+        def body(h, xs):
+            p, kp, vp = xs
+            n_blocks, blk = kp.shape[0], kp.shape[1]
+            flat_shape = (n_blocks * blk,) + kp.shape[2:]
+            x = self._ln(p["ln1"], h)
+            q, k, v = self._qkv_heads(p["qkv"], x, positions=positions)
+            # (b, nh, K, d) -> (b, K, nh, d): page rows are (head, dim)
+            kp = kp.reshape(flat_shape).at[write_flat].set(
+                k.transpose(0, 2, 1, 3).astype(kp.dtype)).reshape(kp.shape)
+            vp = vp.reshape(flat_shape).at[write_flat].set(
+                v.transpose(0, 2, 1, 3).astype(vp.dtype)).reshape(vp.shape)
+            attn = flash_decode_multi(
+                q, kp, vp, block_tables, attend_lengths,
+                window=c.attention_window, impl=c.attention_impl)
+            h = h + self._attn_out(p, attn)
+            h = h + self._mlp(p, self._ln(p["ln2"], h))
+            return h, (kp, vp)
+
+        h, (kps, vps) = lax.scan(body, h, (layers, k_pages, v_pages))
+        return h, kps, vps
+
     def serve_head(self, params: Params, h: jax.Array) -> jax.Array:
         """Final LN + tied LM head returning FULL-vocab logits on every
         rank: under TP the vocab-sharded logits all-gather over the model

@@ -332,6 +332,36 @@ def analyze(
             sv["queue_depth"] = _dist(qd)
         if occ:
             sv["slot_occupancy"] = _dist(occ)
+        # ISSUE 12 rollups — prefill records carry the prefix-sharing and
+        # chunked-prefill evidence, step records the accepted draft length
+        pf = [r for r in records if r.get("kind") == "prefill"]
+        cached = [(r["cached_tokens"], r.get("prompt_len", 0)) for r in pf
+                  if isinstance(r.get("cached_tokens"), (int, float))]
+        if cached:
+            tot_prompt = sum(p for _, p in cached)
+            # token-level hit rate: the fraction of prompt tokens whose
+            # prefill was SKIPPED by a cached prefix (the FLOPs claim)
+            sv["prefix_hit_rate"] = round(
+                sum(c for c, _ in cached) / tot_prompt, 4) if tot_prompt \
+                else 0.0
+            sv["pages_saved"] = int(sum(
+                r.get("pages_shared", 0) for r in pf
+                if isinstance(r.get("pages_shared"), (int, float))))
+            sv["cow_forks"] = int(sum(
+                r.get("cow_forks", 0) for r in pf
+                if isinstance(r.get("cow_forks"), (int, float))))
+        qdel = [1e3 * r["queue_delay_s"] for r in pf
+                if isinstance(r.get("queue_delay_s"), (int, float))]
+        if qdel:
+            sv["prefill_queue_delay_ms"] = _dist(qdel)
+        chunks = [r["chunks"] for r in pf
+                  if isinstance(r.get("chunks"), (int, float))]
+        if chunks:
+            sv["prefill_chunks"] = int(sum(chunks))
+        acc = [r["accepted_len"] for r in steps
+               if isinstance(r.get("accepted_len"), (int, float))]
+        if acc:
+            sv["accepted_len"] = _dist(acc)
         out["serving"] = sv
 
     # overflow / forensics / recompile rollups
@@ -459,6 +489,17 @@ def render(analysis: Dict[str, Any], file=None) -> None:
             parts.append(f"queue p50 {sv['queue_depth']['p50']}")
         if sv.get("slot_occupancy"):
             parts.append(f"occupancy p50 {sv['slot_occupancy']['p50']}")
+        if sv.get("prefix_hit_rate") is not None:
+            parts.append(f"prefix hit-rate {sv['prefix_hit_rate']} "
+                         f"({sv.get('pages_saved', 0)} page(s) shared, "
+                         f"{sv.get('cow_forks', 0)} COW fork(s))")
+        if sv.get("prefill_queue_delay_ms"):
+            parts.append(
+                f"prefill queue delay p50 "
+                f"{sv['prefill_queue_delay_ms']['p50']}ms")
+        if sv.get("accepted_len"):
+            parts.append(f"accepted draft len p50 "
+                         f"{sv['accepted_len']['p50']}")
         p("serving: " + "; ".join(parts))
     p(f"overflows: {analysis.get('overflows', 0)}")
     fo = analysis.get("forensics")
@@ -536,7 +577,12 @@ def compare(
     gate symmetrically: B must still serve requests when A did, TTFT/ITL
     p50 must not grow past ``threshold`` (+0.05 ms timer-noise slack), and
     per-user tokens/s must not drop — the latency-shaped regression gate
-    ISSUE 10's satellite adds.
+    ISSUE 10's satellite adds. ISSUE 12 extends them: the ITL p99 TAIL
+    must not grow (+0.5 ms slack — the monolithic-long-prompt stall the
+    chunked prefill exists to remove lives in the tail), and the prefix
+    hit-rate / mean accepted draft length (``kind="prefill"`` and step
+    ``accepted_len`` stamps) must not DROP — the same
+    :func:`must_not_drop` predicate throughput uses.
 
     ``bubble_threshold`` tunes the pipeline bubble-fraction gate
     independently of ``threshold`` (it defaults to ``threshold`` when
@@ -658,9 +704,29 @@ def compare(
               (sva.get(key) or {}).get("p50"),
               (svb.get(key) or {}).get("p50"),
               worse=must_not_grow(threshold, slack=0.05))
+    # the ITL TAIL gates too (ISSUE 12): a monolithic long-prompt prefill
+    # stalls every running stream for the whole prompt — a p99 spike the
+    # p50 can hide when only a few samples land in the stall. Larger
+    # absolute slack: the tail of a tiny off-TPU run is timer-noisy.
+    check("itl_ms_p99",
+          (sva.get("itl_ms") or {}).get("p99"),
+          (svb.get("itl_ms") or {}).get("p99"),
+          worse=must_not_grow(threshold, slack=0.5))
     check("tokens_per_sec_per_user_p50",
           (sva.get("tokens_per_sec_per_user") or {}).get("p50"),
           (svb.get("tokens_per_sec_per_user") or {}).get("p50"),
+          worse=must_not_drop(threshold))
+    # prefix-sharing / speculative-decoding regression gates (ISSUE 12):
+    # the prefix hit-rate and the mean accepted draft length are
+    # higher-is-better — a candidate that silently dropped sharing or
+    # whose draft stopped agreeing regresses through the SAME
+    # must_not_drop predicate throughput uses
+    check("prefix_hit_rate", sva.get("prefix_hit_rate"),
+          svb.get("prefix_hit_rate"),
+          worse=must_not_drop(threshold))
+    check("accepted_len_p50",
+          (sva.get("accepted_len") or {}).get("p50"),
+          (svb.get("accepted_len") or {}).get("p50"),
           worse=must_not_drop(threshold))
     regressed = [c["check"] for c in checks if c["regressed"]]
     return {"threshold": threshold, "checks": checks,

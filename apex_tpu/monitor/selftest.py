@@ -47,9 +47,15 @@ that the monitor pieces stay importable and functional:
     continuous-batched requests through the paged KV cache and the
     tokens match the full-context forward's argmax at every position;
     pages and slots all release; per-request journal records roll up
-    into report's serving section; and the decode-recompile tripwire
+    into report's serving section; the decode-recompile tripwire
     passes the engine's real tick argument stream while flagging a
-    growing per-request KV tensor.
+    growing per-request KV tensor; a SHARED-PREFIX pair through a
+    prefix-cache + speculative engine has the second request skip
+    prefill to its divergence point with zero page leaks after the
+    cache drops; and the extended tripwire audits the chunked-prefill
+    and speculative-verify streams both ways (clean real streams pass,
+    a growing chunk width / python-int draft length is flagged by
+    stream name).
 
 Wired into ``__graft_entry__.dryrun_multichip`` so the multi-chip gate also
 proves telemetry stays cheap. Prints one JSON line; exit 0 iff ``all_ok``.
@@ -637,8 +643,51 @@ def _check_serve() -> dict:
                    jnp.zeros((2,), jnp.int32)), ticks=2)
     assert grow["hazard"], grow
     assert grow["findings"][0]["rule"] == "decode-shape-churn", grow
+
+    # ISSUE 12: shared-prefix pair — the second request must SKIP prefill
+    # to the divergence point (prompt blocks shared by reference), decode
+    # exactly, and release every page once the cache drops its refs
+    eng2 = Engine(model, params,
+                  ServeConfig(max_batch=2, max_seq=24, block_size=8,
+                              prefix_cache=True, spec_k=2))
+    base = [3, 1, 4, 1, 5, 9, 2, 6]  # one full block
+    res2 = eng2.run([Request(prompt=base + [5, 3], max_new_tokens=3,
+                             request_id="p"),
+                     Request(prompt=base + [8, 9, 7], max_new_tokens=3,
+                             request_id="q")])
+    for req in res2.values():
+        seq = list(req.prompt) + req.tokens
+        ref = jnp.argmax(
+            model.apply(params, jnp.asarray([seq], jnp.int32))[0], -1)
+        want = [int(v) for v in np.asarray(ref)[len(req.prompt) - 1:-1]]
+        assert req.tokens == want, (req.request_id, req.tokens, want)
+    assert res2["q"].cached_tokens >= len(base), res2["q"].cached_tokens
+    assert eng2.stats["tokens_reused"] >= len(base), eng2.stats
+    eng2.drop_prefix_cache()
+    assert eng2.allocator.used == 0 and eng2.batcher.idle  # zero leaks
+
+    # the extended tripwire covers the chunked-prefill and speculative-
+    # verify streams both ways: the real streams pass, a growing chunk
+    # width / python-int draft length is flagged with its stream name
+    multi = decode_recompile_hazards(
+        eng2.decode_args, ticks=3,
+        extra_streams={"chunk": eng2.chunk_args, "verify": eng2.spec_args})
+    assert not multi["hazard"], multi["findings"][:2]
+    assert multi["stream_leaves"]["chunk"] > 0
+    assert multi["stream_leaves"]["verify"] > 0
+    bad = decode_recompile_hazards(
+        eng2.decode_args, ticks=2,
+        extra_streams={"chunk": lambda t: (
+            jnp.zeros((1, 8 * (t + 1)), jnp.int32),),
+            "verify": lambda t: (jnp.zeros((2, 3), jnp.int32), t)})
+    assert bad["hazard"], bad
+    rules = {(f["stream"], f["rule"]) for f in bad["findings"]}
+    assert ("chunk", "decode-shape-churn") in rules, rules
+    assert ("verify", "recompile-hazard") in rules, rules
     return {"ok": True, "requests": len(res),
-            "decode_leaves": clean["leaves"]}
+            "decode_leaves": clean["leaves"],
+            "prefix_cached_tokens": int(res2["q"].cached_tokens),
+            "spec_accepted_mean": eng2.stats["mean_accepted_len"]}
 
 
 def run() -> dict:

@@ -9,14 +9,17 @@ extension the ROADMAP's "millions of users, heavy traffic" north star needs
 Pieces:
 
 - :mod:`.cache`     — fixed-size KV pages in a preallocated pool + the
-  host-side :class:`BlockAllocator` (per-request KV never recompiles or
-  lane-pads; see the layout note there and PERF_NOTES r11);
+  host-side refcounted :class:`BlockAllocator` and the prefix-sharing
+  :class:`PrefixCache` (ISSUE 12: matched prompt prefixes share pages by
+  reference, copy-on-write on divergence; per-request KV never recompiles
+  or lane-pads — see the layout note there and PERF_NOTES r11);
 - :mod:`.scheduler` — :class:`ContinuousBatcher`: FIFO request queue over a
   fixed slot array, admission each tick, slot reuse after retirement;
 - :mod:`.sampler`   — greedy + temperature/top-k sampling with per-slot
   PRNG keys;
-- :mod:`.engine`    — :class:`Engine`: two jitted shape-stable programs
-  (prefill, decode) over ``max_batch`` slots, TP-sharded via ``shard_map``
+- :mod:`.engine`    — :class:`Engine`: jitted shape-stable programs
+  (prefill, decode, static-width prefill CHUNK, speculative draft-propose
+  + K-query verify) over ``max_batch`` slots, TP-sharded via ``shard_map``
   + the mappings.py conjugates, request-level journaling through
   ``monitor.MetricsJournal``.
 """
@@ -26,6 +29,7 @@ from apex_tpu.serve.cache import (  # noqa: F401
     CacheOutOfBlocks,
     KVCacheConfig,
     NULL_BLOCK,
+    PrefixCache,
     init_kv_cache,
     kv_cache_spec,
 )

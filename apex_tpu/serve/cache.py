@@ -25,12 +25,23 @@ Block 0 is the reserved NULL page: idle slots and masked scatter lanes write
 there, and table slots beyond a sequence's allocation point there so the
 sequential decode grid always fetches a valid page (flash_decode masks those
 trips by length). The allocator never hands it out.
+
+Prefix sharing (ISSUE 12): the block-table indirection built for paging IS
+the sharing primitive. Blocks carry REFERENCE COUNTS — a prefill whose
+prompt prefix matches a cached chain (:class:`PrefixCache`) bumps the
+matched blocks' refcounts into its own table instead of recomputing and
+re-storing their k/v, and skips straight to the divergence point. A block
+with refcount > 1 is immutable to any single holder: before writing into it
+(a diverging suffix, or generation appending into a partially-matched
+block), the engine COW-forks it — allocate fresh, device-copy the page,
+swap the table entry, drop one reference — so a diverging request can never
+perturb another stream's cached keys.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 #: the reserved scratch page every table defaults to (never allocated)
 NULL_BLOCK = 0
@@ -41,14 +52,21 @@ class CacheOutOfBlocks(RuntimeError):
 
 
 class BlockAllocator:
-    """Free-list allocator over the page pool (host-side, O(1) alloc/free).
+    """Refcounted free-list allocator over the page pool (host-side, O(1)).
 
     Invariants (unit-tested): block 0 is never handed out; a block is never
-    handed out twice without an intervening free; freeing a free (or
-    out-of-range, or null) block raises. Freed blocks are reusable
-    immediately — the pool cannot fragment (every block is one fixed-size
-    page; "fragmentation" is bounded to internal waste within a sequence's
-    last partial page).
+    handed out twice without intervening release; ``free`` of an unallocated
+    (or out-of-range, or null) block raises (double-free detection). Freed
+    blocks are reusable immediately — the pool cannot fragment (every block
+    is one fixed-size page; "fragmentation" is bounded to internal waste
+    within a sequence's last partial page).
+
+    Reference counting (prefix sharing): ``alloc`` hands a block out at
+    refcount 1; :meth:`incref` registers another holder (a prefix-cache
+    entry, a second sequence's table); ``free`` DECREMENTS, and the page
+    returns to the free list only at zero. A shared block
+    (:meth:`is_shared`) must never be written in place — holders COW-fork
+    first (serve/engine.py owns the device copy).
     """
 
     def __init__(self, num_blocks: int):
@@ -59,7 +77,7 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         # LIFO free list: recently-freed (likely cache-warm) pages reused first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
-        self._allocated = [False] * self.num_blocks
+        self._refcount = [0] * self.num_blocks
 
     @property
     def available(self) -> int:
@@ -69,12 +87,26 @@ class BlockAllocator:
     def used(self) -> int:
         return self.num_blocks - 1 - len(self._free)
 
+    def refcount(self, block: int) -> int:
+        return self._refcount[int(block)]
+
+    def is_shared(self, block: int) -> bool:
+        """More than one holder: writes must COW-fork first."""
+        return self._refcount[int(block)] > 1
+
+    def _check_id(self, b: int) -> int:
+        b = int(b)
+        if not 0 < b < self.num_blocks:
+            raise ValueError(f"block {b} out of range (null page is "
+                             f"never ref-counted)")
+        return b
+
     def alloc(self) -> int:
         if not self._free:
             raise CacheOutOfBlocks(
                 f"page pool exhausted ({self.num_blocks - 1} usable blocks)")
         b = self._free.pop()
-        self._allocated[b] = True
+        self._refcount[b] = 1
         return b
 
     def alloc_many(self, n: int) -> List[int]:
@@ -83,16 +115,206 @@ class BlockAllocator:
                 f"need {n} blocks, {len(self._free)} available")
         return [self.alloc() for _ in range(n)]
 
+    def incref(self, block: int) -> int:
+        """Register another holder of an allocated block (prefix sharing)."""
+        b = self._check_id(block)
+        if not self._refcount[b]:
+            raise ValueError(f"incref of unallocated block {b}")
+        self._refcount[b] += 1
+        return b
+
     def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; release to the free list at zero.
+        Dropping a reference a holder does not own raises (double free)."""
         for b in blocks:
-            b = int(b)
-            if not 0 < b < self.num_blocks:
-                raise ValueError(f"block {b} out of range (null page is "
-                                 f"never freed)")
-            if not self._allocated[b]:
+            b = self._check_id(b)
+            if not self._refcount[b]:
                 raise ValueError(f"double free of block {b}")
-            self._allocated[b] = False
-            self._free.append(b)
+            self._refcount[b] -= 1
+            if not self._refcount[b]:
+                self._free.append(b)
+
+
+class _PrefixNode:
+    """One cached FULL block in the prefix trie: its page, its own token
+    tuple (ONLY its block's tokens — the chain, not the node, encodes the
+    prefix, so memory stays O(prompt) per cached prompt), and the trie
+    links."""
+
+    __slots__ = ("block", "tokens", "parent", "children", "by_first", "lru")
+
+    def __init__(self, block: int, tokens: Tuple[int, ...],
+                 parent: Optional["_PrefixNode"]):
+        self.block = block
+        self.tokens = tokens
+        self.parent = parent
+        # child block-token tuple -> node: one dict probe (hashing ONE
+        # block's tokens, not the whole prefix) per chain step — lookup
+        # and insert are O(plen) total, not O(plen^2/blk)
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        # first-token index over the children: the partial-match step only
+        # ever matches a child whose FIRST token agrees, so admission cost
+        # is O(true candidates), not O(all children) — a root with 10^4
+        # unrelated cached prompts costs a miss one dict probe
+        self.by_first: Dict[int, Set["_PrefixNode"]] = {}
+        self.lru = 0
+
+
+class PrefixCache:
+    """Token-prefix → cached block chains (host-side, the sharing trie).
+
+    One node per FULL block of a prefilled prompt; a chain of nodes from
+    the root spells the exact prompt prefix (exact-match walks — each step
+    probes the parent's children by the BLOCK's token tuple, so there is
+    no hash-collision risk and no quadratic full-prefix keying). Each node
+    holds ONE allocator reference on its block, so a cached page survives
+    its originating request's retirement and is reclaimed by :meth:`evict`
+    under pool pressure (leaf-first LRU — evicting a parent before its
+    child would strand the child unreachable mid-walk).
+
+    :meth:`lookup` walks the longest chain of full-block matches, then
+    tries one PARTIAL match inside a child block (the stored block's tokens
+    sharing a prefix with the prompt remainder) — that partially-matched
+    shared block is exactly the COW case: the new request's first divergent
+    write into it must fork it first (serve/engine.py).
+
+    The caller owns one reference per block ``lookup`` returns (increfed
+    here, released by the normal retirement ``free``).
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self._alloc = allocator
+        self.block_size = int(block_size)
+        self._root = _PrefixNode(NULL_BLOCK, (), None)  # sentinel, no page
+        self._nodes: Set[_PrefixNode] = set()
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def _touch(self, node: _PrefixNode) -> None:
+        self._tick += 1
+        node.lru = self._tick
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``prompt``: ``(blocks, n_cached)``.
+
+        ``blocks`` covers table slots ``0..len(blocks)-1`` and holds valid
+        k/v for positions ``[0, n_cached)``; the caller owns one reference
+        per returned block. ``n_cached`` may end mid-block (a partial match
+        — the engine must COW-fork that block before writing past it)."""
+        blk = self.block_size
+        prompt = [int(t) for t in prompt]
+        blocks: List[int] = []
+        n = 0
+        node = self._root
+        while n + blk <= len(prompt):
+            child = node.children.get(tuple(prompt[n:n + blk]))
+            if child is None:
+                break
+            blocks.append(child.block)
+            node = child
+            n += blk
+            self._touch(child)
+        rem = prompt[n:]
+        if rem:
+            best, best_m = None, 0
+            for child in node.by_first.get(rem[0], ()):
+                toks = child.tokens
+                m = 0
+                while m < len(rem) and m < len(toks) and rem[m] == toks[m]:
+                    m += 1
+                if m > best_m:
+                    best, best_m = child, m
+            if best is not None:
+                blocks.append(best.block)
+                n += best_m
+                self._touch(best)
+        for b in blocks:
+            self._alloc.incref(b)
+        if n:
+            self.hits += 1
+            self.tokens_reused += n
+        else:
+            self.misses += 1
+        return blocks, n
+
+    def insert(self, prompt: Sequence[int], table_row: Sequence[int]) -> int:
+        """Register the prompt's FULL blocks (positions ``[0, plen)`` must
+        hold valid k/v in ``table_row``'s pages — call after prefill
+        completes). Existing chain nodes are kept (first writer wins — the
+        chains stay consistent either way); each NEW node takes one
+        reference. Returns the number of nodes added."""
+        blk = self.block_size
+        prompt = [int(t) for t in prompt]
+        added = 0
+        node = self._root
+        for i in range(len(prompt) // blk):
+            toks = tuple(prompt[i * blk:(i + 1) * blk])
+            child = node.children.get(toks)
+            if child is None:
+                b = int(table_row[i])
+                if b == NULL_BLOCK:
+                    break
+                self._alloc.incref(b)
+                child = _PrefixNode(b, toks, node)
+                node.children[toks] = child
+                node.by_first.setdefault(toks[0], set()).add(child)
+                self._nodes.add(child)
+                self._touch(child)
+                added += 1
+            node = child
+        return added
+
+    def _evictable(self, node: _PrefixNode) -> bool:
+        # leaf-first: a cached child under this node would be stranded
+        # (the walk breaks at the missing parent) yet still hold its ref;
+        # refcount 1 = only the cache holds the page — live sequences
+        # still sharing the block keep it pinned
+        return not node.children and self._alloc.refcount(node.block) == 1
+
+    def _remove(self, node: _PrefixNode) -> None:
+        parent = node.parent
+        del parent.children[node.tokens]
+        sibs = parent.by_first.get(node.tokens[0])
+        if sibs is not None:
+            sibs.discard(node)
+            if not sibs:
+                del parent.by_first[node.tokens[0]]
+        self._nodes.discard(node)
+        self._alloc.free([node.block])
+
+    def evict(self, n_blocks: int) -> int:
+        """Release up to ``n_blocks`` pages back to the pool, least-recently
+        used evictable (leaf, cache-only) entries first. One
+        ``heapq.nsmallest`` pass per cascade level (removing leaves exposes
+        their parents), not a full sort per released page. Returns the
+        number of pages actually released."""
+        import heapq
+
+        released = 0
+        while released < n_blocks:
+            victims = heapq.nsmallest(
+                n_blocks - released,
+                (nd for nd in self._nodes if self._evictable(nd)),
+                key=lambda nd: nd.lru)
+            if not victims:
+                break
+            for nd in victims:
+                self._remove(nd)
+                released += 1
+        return released
+
+    def drop(self) -> None:
+        """Release every cache-held reference (shutdown / leak checks)."""
+        for nd in self._nodes:
+            self._alloc.free([nd.block])
+        self._nodes.clear()
+        self._root.children.clear()
+        self._root.by_first.clear()
 
 
 def blocks_for(n_tokens: int, block_size: int) -> int:

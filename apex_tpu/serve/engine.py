@@ -25,6 +25,29 @@ Weights import from training: pass params straight from a train loop or
 checkpoint; for fully-sharded (ZeRO-3) training state use
 :meth:`Engine.params_from_zero3` (``amp.MixedPrecisionOptimizer.
 zero3_materialize`` — gathers the 1/dp chunk trees back to full params).
+
+Production-scale serving (ISSUE 12) — three coupled optimisations over the
+same paged-cache layer, each shape-stable:
+
+- **Prefix sharing** (``ServeConfig.prefix_cache``): a prefill whose prompt
+  prefix matches a cached block chain (serve/cache.PrefixCache) bumps
+  refcounts into its table and prefills only from the divergence point —
+  prefill FLOPs and pages both drop. Writes into a shared block COW-fork it
+  first (``_prepare_write_range``), so a diverging request never perturbs
+  another stream's cached keys.
+- **Chunked prefill** (``ServeConfig.prefill_chunk``): long prompts split
+  into decode-tick-sized STATIC chunks (one more static chunk dimension on
+  the prefill program — the jit signature stays stable) interleaved with
+  running decode ticks, so a 32k-token arrival never freezes in-flight
+  streams' ITL.
+- **Speculative decoding** (``ServeConfig.spec_k``): a draft model proposes
+  k tokens per slot per tick (ONE jitted scan); the target verifies all k
+  in ONE batched shape-stable K-query forward against the same pages
+  (ops/flash_decode.flash_decode_multi), committing the longest matching
+  greedy prefix plus the bonus token — acceptance is EXACT, so greedy
+  output is bit-identical to the non-speculative engine. Greedy only
+  (exact speculative SAMPLING needs rejection-sampling machinery the
+  engine does not carry).
 """
 
 from __future__ import annotations
@@ -42,13 +65,22 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from apex_tpu.serve.cache import (
     NULL_BLOCK,
     BlockAllocator,
+    CacheOutOfBlocks,
     KVCacheConfig,
+    PrefixCache,
     blocks_for,
     init_kv_cache,
     kv_cache_spec,
 )
 from apex_tpu.serve.sampler import fold_tick, sample_tokens
 from apex_tpu.serve.scheduler import ContinuousBatcher, Request
+
+#: COW fork pairs copied per device launch (fixed-width index vectors keep
+#: the copy program's jit signature stable; padding copies null -> null)
+_COW_BATCH = 8
+#: minimum pages reclaimed per prefix-cache eviction scan (amortizes the
+#: evictable-set walk under sustained pool pressure)
+_EVICT_BATCH = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,15 +98,38 @@ class ServeConfig:
     seed: int = 0
     eos_id: Optional[int] = None
     decode_impl: Optional[str] = None  # override model attention_impl
+    # -- ISSUE 12 knobs ------------------------------------------------------
+    # prefix sharing: cache prefilled prompt blocks (refcounts + COW) and
+    # skip matched prefixes straight to their divergence point
+    prefix_cache: bool = False
+    # chunked prefill: split prompts into static chunks of this many tokens,
+    # one chunk per engine tick interleaved with decode (None = the whole
+    # prompt in one launch). Any of the three knobs below routes prefill
+    # through the SAME chunk program (prefix hits need a mid-prompt start;
+    # speculative decoding needs the draft cache filled alongside).
+    prefill_chunk: Optional[int] = None
+    # speculative decoding: draft tokens proposed per slot per tick
+    # (0 = off; > 0 needs temperature == 0 — greedy-exact verification)
+    spec_k: int = 0
 
     def resolved(self) -> "ServeConfig":
         pf = self.prefill_len or self.max_seq
+        pf = min(pf, self.max_seq)
         nb = self.num_blocks
         if nb is None:
             nb = self.max_batch * blocks_for(self.max_seq,
                                              self.block_size) + 1
-        return dataclasses.replace(self, prefill_len=min(pf, self.max_seq),
-                                   num_blocks=nb)
+        pc = self.prefill_chunk
+        if pc is not None:
+            pc = max(1, min(int(pc), pf))
+        if self.spec_k and self.temperature != 0.0:
+            raise ValueError(
+                "spec_k > 0 requires temperature == 0: speculative "
+                "verification is greedy-exact (argmax agreement); exact "
+                "speculative SAMPLING needs rejection sampling the engine "
+                "does not implement")
+        return dataclasses.replace(self, prefill_len=pf, num_blocks=nb,
+                                   prefill_chunk=pc)
 
 
 class Engine:
@@ -85,7 +140,8 @@ class Engine:
     >>> results = eng.run(journal=journal)   # {request_id: Request}
     """
 
-    def __init__(self, model, params, config: ServeConfig, mesh=None):
+    def __init__(self, model, params, config: ServeConfig, mesh=None,
+                 draft_model=None, draft_params=None):
         model.check_servable()
         c = model.cfg
         self.model = model
@@ -108,9 +164,53 @@ class Engine:
         self.kv_config = kv_cfg
         self.allocator = BlockAllocator(kv_cfg.num_blocks)
         self.batcher = ContinuousBatcher(cfg.max_batch)
+        self.prefix_cache = (PrefixCache(self.allocator, cfg.block_size)
+                             if cfg.prefix_cache else None)
+
+        # the serving twin of the model (decode_impl override rides the
+        # frozen model config, shared by every compiled program)
+        self._smodel = model
+        if cfg.decode_impl is not None:
+            self._smodel = type(model)(dataclasses.replace(
+                model.cfg, attention_impl=cfg.decode_impl))
+
+        # -- draft model (speculative decoding) -----------------------------
+        self.draft_model = self._dmodel = None
+        self.draft_params = None
+        if cfg.spec_k:
+            dm = draft_model if draft_model is not None else model
+            dp = draft_params if draft_params is not None else params
+            dm.check_servable()
+            if dm.cfg.axis != c.axis:
+                raise ValueError(
+                    "the draft model must share the target's tensor-"
+                    "parallel axis (both programs run inside the same "
+                    "mesh context)")
+            if cfg.max_seq > dm.cfg.max_seq_len:
+                raise ValueError(
+                    f"max_seq ({cfg.max_seq}) exceeds the draft model's "
+                    f"max_seq_len ({dm.cfg.max_seq_len})")
+            self.draft_model = dm
+            self.draft_params = dp
+            self._dmodel = dm
+            if cfg.decode_impl is not None:
+                self._dmodel = type(dm)(dataclasses.replace(
+                    dm.cfg, attention_impl=cfg.decode_impl))
 
         # -- device state ---------------------------------------------------
         k_pages, v_pages = init_kv_cache(kv_cfg)
+        dk_pages = dv_pages = None
+        if self.draft_model is not None:
+            dc = self.draft_model.cfg
+            # the DRAFT cache rides the SAME block tables/allocator: its
+            # pool has the draft model's geometry but identical block
+            # count/size, so every block id addresses both caches at once
+            # (prefix sharing and COW forks cover the pair together)
+            self.draft_kv_config = KVCacheConfig(
+                num_layers=dc.num_layers, kv_heads=dc.num_attention_heads,
+                head_dim=dc.head_dim, block_size=cfg.block_size,
+                num_blocks=cfg.num_blocks, dtype=dc.compute_dtype)
+            dk_pages, dv_pages = init_kv_cache(self.draft_kv_config)
         if mesh is not None:
             from apex_tpu.transformer import tensor_parallel as tp_mod
 
@@ -118,8 +218,14 @@ class Engine:
             cspec = NamedSharding(mesh, kv_cache_spec(self.axis))
             k_pages = jax.device_put(k_pages, cspec)
             v_pages = jax.device_put(v_pages, cspec)
+            if self.draft_model is not None:
+                self.draft_params = tp_mod.shard_params(
+                    self.draft_params, self.draft_model.specs(), mesh)
+                dk_pages = jax.device_put(dk_pages, cspec)
+                dv_pages = jax.device_put(dv_pages, cspec)
         self.params = params
         self._k_pages, self._v_pages = k_pages, v_pages
+        self._dk_pages, self._dv_pages = dk_pages, dv_pages
 
         # -- host state (one row per slot) ----------------------------------
         B = cfg.max_batch
@@ -139,19 +245,57 @@ class Engine:
         self._base_keys = jax.random.split(
             jax.random.PRNGKey(cfg.seed), B)  # (B, 2) uint32
         self.ticks = 0
+        # -- ISSUE 12 state -------------------------------------------------
+        # absolute write ceiling per slot (prompt + max_new): speculative
+        # writes past it mask to the null page, keeping every launch inside
+        # the slot's admission reservation
+        self._write_cap = np.zeros((B,), np.int32)
+        # slots seated but still prefilling (chunked): slot -> progress
+        self._prefilling: Dict[int, Dict[str, Any]] = {}
+        self.cow_forks = 0
+        self.accepted_total = 0
+        self.accept_events = 0  # (slot, tick) commits: the mean's divisor
+        self.spec_ticks = 0
+        # any of the three features routes prefill through the chunk program
+        self._chunk_armed = bool(cfg.prefix_cache or cfg.prefill_chunk
+                                 or cfg.spec_k)
+        # default chunk width when only prefix_cache/spec_k arm the path:
+        # clamp to a VMEM-safe K — flash_decode_multi's kernel scratch
+        # scales linearly with the query count, so K = prefill_len at long
+        # context would blow Mosaic's VMEM budget at compile time (the
+        # prompt still prefills in one _admit call, just in several
+        # launches — monolithic timing, bounded residency)
+        self._chunk_width = cfg.prefill_chunk or min(cfg.prefill_len, 256)
 
         self._prefill_fn, self._decode_fn = self._build_steps()
+        self._chunk_fn = self._chunk_mid_fn = self._draft_chunk_fn = None
+        self._propose_fn = self._verify_fn = None
+        self._cow_fn = None
+        if self._chunk_armed:
+            # two target chunk programs, same signature: only the FINAL
+            # chunk needs the vocab projection + sampling — non-final
+            # chunks skip the hidden x vocab GEMM (and, under TP, its
+            # full-vocab all-gather) whose result would be discarded
+            self._chunk_fn = self._build_chunk(self._smodel, sample=True)
+            self._chunk_mid_fn = self._build_chunk(self._smodel,
+                                                   sample=False)
+            self._cow_fn = jax.jit(
+                lambda pools, src, dst: tuple(
+                    p.at[:, dst].set(p[:, src]) for p in pools))
+            if self.draft_model is not None:
+                self._draft_chunk_fn = self._build_chunk(
+                    self._dmodel, sample=False)
+        if cfg.spec_k:
+            self._propose_fn, self._verify_fn = self._build_spec()
 
     # -- compiled programs --------------------------------------------------
 
     def _build_steps(self):
-        model, cfg = self.model, self.config
+        cfg = self.config
         temperature, top_k = cfg.temperature, cfg.top_k
-        # decode_impl override rides the model config (frozen dataclass):
-        # rebuild with the override so prefill/decode agree on the kernel
-        if cfg.decode_impl is not None:
-            model = type(self.model)(dataclasses.replace(
-                self.model.cfg, attention_impl=cfg.decode_impl))
+        # decode_impl override rides the model config (frozen dataclass,
+        # resolved once in __init__) so every program agrees on the kernel
+        model = self._smodel
 
         def prefill(p, kp, vp, table_row, prompt, prompt_len, key, tick):
             pf = prompt.shape[1]
@@ -207,6 +351,130 @@ class Engine:
             out_specs=(cspec, cspec, r), check_vma=False)
         return jax.jit(prefill_sm), jax.jit(decode_sm)
 
+    def _build_chunk(self, smodel, *, sample: bool):
+        """ONE static-width prefill-chunk program (per model): tokens
+        arrive ``(1, C)`` RIGHT-ALIGNED (the real ``n_valid`` tokens fill
+        columns ``C - n_valid .. C - 1``; column ``C-1`` sits at position
+        ``start + n_valid - 1``), k/v write through the slot's table row
+        (padding columns to the null page), attention is the K-query
+        flash-decode with trailing-query semantics — so one jit signature
+        covers every (start, n_valid) a prompt walk produces, including a
+        prefix-cache hit's mid-prompt start. ``sample=True`` also samples
+        from the final column's logits (used only on the last chunk)."""
+        cfg = self.config
+        C = self._chunk_width
+        temperature, top_k = cfg.temperature, cfg.top_k
+        max_pos = smodel.cfg.max_seq_len - 1
+
+        def chunk(p, kp, vp, table_row, tokens, start, n_valid, key, tick):
+            ci = jnp.arange(C, dtype=jnp.int32)
+            pos = start + n_valid - C + ci
+            valid = ci >= (C - n_valid)
+            pos_c = jnp.clip(pos, 0, max_pos)
+            h = smodel.embed_at(p, tokens, pos_c[None])
+            blk = kp.shape[2]
+            flat = table_row[pos_c // blk] * blk + pos_c % blk
+            write_flat = jnp.where(valid, flat, NULL_BLOCK)
+            attend = (start + n_valid)[None]
+            h, kp, vp = smodel.serve_layers_multi(
+                p["layers"], h, kp, vp, table_row[None], write_flat[None],
+                attend, pos_c[None])
+            if not sample:
+                return kp, vp
+            logits = smodel.serve_head(p, h[:, C - 1:])[:, 0]  # (1, vocab)
+            tok = sample_tokens(logits, fold_tick(key[None], tick),
+                                temperature=temperature, top_k=top_k)
+            return kp, vp, tok[0]
+
+        if self.axis is None:
+            return jax.jit(chunk)
+        specs = smodel.specs()
+        cspec = kv_cache_spec(self.axis)
+        r = P()
+        out_specs = (cspec, cspec, r) if sample else (cspec, cspec)
+        chunk_sm = jax.shard_map(
+            chunk, mesh=self.mesh,
+            in_specs=(specs, cspec, cspec, r, r, r, r, r, r),
+            out_specs=out_specs, check_vma=False)
+        return jax.jit(chunk_sm)
+
+    def _build_spec(self):
+        """The speculative pair: ``propose`` runs K = spec_k + 1 greedy
+        draft-decode steps in ONE jitted scan (step i feeds token x_i at
+        position ``lengths + i``, writing its draft k/v — no cache holes
+        whatever the later acceptance — and emits x_{i+1}; x_0 is the
+        pending token), returning the fed tokens ``(B, K)``; ``verify``
+        runs the target over ALL K fed tokens in ONE batched shape-stable
+        K-query forward against the same pages and returns per-position
+        greedy argmax ``(B, K)``. The host commits the longest prefix
+        where draft and target agree (plus the bonus token) — exactness
+        by construction: row j sees exactly the context a sequential
+        decode would have seen."""
+        smodel, dmodel = self._smodel, self._dmodel
+        K = self.config.spec_k + 1
+        nb_seq = self._nb_per_seq
+        max_pos_t = smodel.cfg.max_seq_len - 1
+        max_pos_d = dmodel.cfg.max_seq_len - 1
+
+        def propose(p, kp, vp, tables, lengths, t0, active, caps):
+            blk = kp.shape[2]
+
+            def step(carry, i):
+                kp, vp, tok = carry
+                pos = lengths + i
+                bi = jnp.clip(pos // blk, 0, nb_seq - 1)
+                blk_ids = jnp.take_along_axis(tables, bi[:, None],
+                                              axis=1)[:, 0]
+                ok = active & (pos < caps)
+                write_flat = jnp.where(ok, blk_ids * blk + pos % blk,
+                                       NULL_BLOCK)
+                attend = jnp.where(active, pos + 1, 0)
+                pos_c = jnp.clip(pos, 0, max_pos_d)
+                h = dmodel.embed_at(p, tok[:, None], pos_c[:, None])
+                h, kp, vp = dmodel.serve_layers_decode(
+                    p["layers"], h, kp, vp, tables, write_flat, attend,
+                    pos_c)
+                logits = dmodel.serve_head(p, h)[:, 0]
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (kp, vp, jnp.where(active, nxt, 0)), tok
+
+            (kp, vp, _), fed = lax.scan(
+                step, (kp, vp, t0), jnp.arange(K, dtype=jnp.int32))
+            return kp, vp, fed.T  # (B, K): [t0, d1, .., d_{K-1}]
+
+        def verify(p, kp, vp, tables, lengths, xs, active, caps):
+            blk = kp.shape[2]
+            j = jnp.arange(K, dtype=jnp.int32)
+            pos = lengths[:, None] + j[None, :]  # (B, K)
+            bi = jnp.clip(pos // blk, 0, nb_seq - 1)
+            blk_ids = jnp.take_along_axis(tables, bi, axis=1)
+            ok = active[:, None] & (pos < caps[:, None])
+            write_flat = jnp.where(ok, blk_ids * blk + pos % blk,
+                                   NULL_BLOCK)
+            attend = jnp.where(active, lengths + K, 0)
+            pos_c = jnp.clip(pos, 0, max_pos_t)
+            h = smodel.embed_at(p, xs, pos_c)
+            h, kp, vp = smodel.serve_layers_multi(
+                p["layers"], h, kp, vp, tables, write_flat, attend, pos_c)
+            logits = smodel.serve_head(p, h)  # (B, K, vocab)
+            y = jnp.argmax(logits, -1).astype(jnp.int32)
+            return kp, vp, jnp.where(active[:, None], y, 0)
+
+        if self.axis is None:
+            return jax.jit(propose), jax.jit(verify)
+        cspec = kv_cache_spec(self.axis)
+        r = P()
+        propose_sm = jax.shard_map(
+            propose, mesh=self.mesh,
+            in_specs=(self.draft_model.specs(), cspec, cspec,
+                      r, r, r, r, r),
+            out_specs=(cspec, cspec, r), check_vma=False)
+        verify_sm = jax.shard_map(
+            verify, mesh=self.mesh,
+            in_specs=(self.model.specs(), cspec, cspec, r, r, r, r, r),
+            out_specs=(cspec, cspec, r), check_vma=False)
+        return jax.jit(propose_sm), jax.jit(verify_sm)
+
     # -- request lifecycle --------------------------------------------------
 
     def _worst_case_blocks(self, request: Request) -> int:
@@ -247,6 +515,131 @@ class Engine:
                 jnp.asarray(self._active), self._base_keys,
                 jnp.asarray(2 * tick, jnp.int32))
 
+    def chunk_args(self, tick: int):
+        """The EXACT argument tuple a chunked-prefill launch ships at tick
+        ``tick`` — the second input stream the extended
+        ``lint.trace.decode_recompile_hazards`` audits: the chunk count is
+        one more STATIC dimension, so start/n_valid are committed int32
+        scalars and the signature never grows with the prompt."""
+        if self._chunk_fn is None:
+            raise ValueError(
+                "the chunk program is not armed (set prefill_chunk, "
+                "prefix_cache, or spec_k)")
+        C = self._chunk_width
+        return (self.params, self._k_pages, self._v_pages,
+                jnp.asarray(self._tables[0]),
+                jnp.zeros((1, C), jnp.int32),
+                jnp.asarray(min(tick * C, self.config.max_seq - C),
+                            jnp.int32),
+                jnp.asarray(C, jnp.int32), self._base_keys[0],
+                jnp.asarray(2 * tick + 1, jnp.int32))
+
+    def spec_args(self, tick: int):
+        """The EXACT argument tuple a speculative-verify launch ships at
+        tick ``tick`` — the third audited input stream: the draft length
+        is a static program dimension (K = spec_k + 1 token columns), not
+        a python int riding the args."""
+        if self._verify_fn is None:
+            raise ValueError("speculative decoding is not armed (spec_k=0)")
+        K = self.config.spec_k + 1
+        return (self.params, self._k_pages, self._v_pages,
+                jnp.asarray(self._tables), jnp.asarray(self._lengths),
+                jnp.zeros((self.config.max_batch, K), jnp.int32),
+                jnp.asarray(self._active), jnp.asarray(self._write_cap))
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """Host-side feature counters (prefix sharing / COW / speculation)
+        — the numbers the serve evidence and the example harness print."""
+        s: Dict[str, Any] = {"cow_forks": self.cow_forks}
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache
+            s.update(prefix_hits=pc.hits, prefix_misses=pc.misses,
+                     tokens_reused=pc.tokens_reused,
+                     cached_blocks=len(pc))
+        if self.config.spec_k:
+            s.update(spec_ticks=self.spec_ticks,
+                     accepted_total=self.accepted_total,
+                     mean_accepted_len=(
+                         round(self.accepted_total / self.accept_events, 4)
+                         if self.accept_events else None))
+        return s
+
+    def drop_prefix_cache(self) -> None:
+        """Release every prefix-cache page reference (shutdown / leak
+        checks: after this, ``allocator.used`` counts live slots only)."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.drop()
+
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Allocate ``n`` pages, reclaiming least-recently-used prefix-cache
+        entries under pool pressure (cache-held pages are opportunistic:
+        evictable on demand, so they never break the reservation
+        invariant)."""
+        try:
+            return self.allocator.alloc_many(n)
+        except CacheOutOfBlocks:
+            if self.prefix_cache is None:
+                raise
+            # evict a small batch past the immediate deficit: sustained
+            # pressure otherwise pays one evict scan per single page
+            self.prefix_cache.evict(
+                max(n - self.allocator.available, _EVICT_BATCH))
+            return self.allocator.alloc_many(n)
+
+    def _cow_copy_many(self, pairs: List[Tuple[int, int]]) -> None:
+        """Device-copy forked pages (every layer, target AND draft pools)
+        — the copy half of copy-on-write. Batched: up to ``_COW_BATCH``
+        (src, dst) pairs per launch against a FIXED-width index vector
+        (padding pairs copy null→null, a no-op), so a write range that
+        forks several blocks costs one functional pool rewrite, not one
+        per block."""
+        pools = (self._k_pages, self._v_pages)
+        if self._dk_pages is not None:
+            pools = pools + (self._dk_pages, self._dv_pages)
+        for i in range(0, len(pairs), _COW_BATCH):
+            batch = pairs[i:i + _COW_BATCH]
+            src = np.zeros((_COW_BATCH,), np.int32)
+            dst = np.zeros((_COW_BATCH,), np.int32)
+            for j, (s, d) in enumerate(batch):
+                src[j], dst[j] = s, d
+            pools = self._cow_fn(pools, jnp.asarray(src), jnp.asarray(dst))
+        self._k_pages, self._v_pages = pools[0], pools[1]
+        if self._dk_pages is not None:
+            self._dk_pages, self._dv_pages = pools[2], pools[3]
+
+    def _prepare_write_range(self, slot: int, pos0: int, n: int) -> None:
+        """Every position in ``[pos0, pos0 + n)`` (clipped to the slot's
+        write cap) gets a WRITABLE page before the jitted step runs:
+        missing table entries allocate on demand (continuous batching grows
+        a sequence one block at a time — cannot fail, the admission
+        reservation covers the slot's whole lifetime), and SHARED blocks
+        (refcount > 1: a prefix-cache entry or another stream also holds
+        them) COW-fork — allocate fresh, device-copy the page, swap the
+        table entry, drop this slot's reference on the original — so no
+        shared block is ever mutated in place."""
+        blk = self.config.block_size
+        end = min(pos0 + n, int(self._write_cap[slot]))
+        if end <= pos0:
+            return
+        forks: List[Tuple[int, int]] = []
+        for bi in range(pos0 // blk, (end - 1) // blk + 1):
+            b = int(self._tables[slot, bi])
+            if b == NULL_BLOCK:
+                nb = self._alloc_blocks(1)[0]
+                self._slot_blocks[slot].append(nb)
+                self._tables[slot, bi] = nb
+            elif self.allocator.is_shared(b):
+                nb = self._alloc_blocks(1)[0]
+                forks.append((b, nb))
+                self._tables[slot, bi] = nb
+                self._slot_blocks[slot].append(nb)
+                self._slot_blocks[slot].remove(b)
+                self.allocator.free([b])
+                self.cow_forks += 1
+        if forks:
+            self._cow_copy_many(forks)
+
     def _admit(self, journal) -> None:
         """Fill free slots from the queue; one shape-stable prefill each.
 
@@ -255,7 +648,15 @@ class Engine:
         active slot's reservation. Invariant (the no-preemption guarantee):
         ``sum(reserved) <= usable`` and each slot allocates at most its
         reservation, so ``allocator.available >= reserved_i - allocated_i``
-        for every slot — mid-run growth never finds the pool empty."""
+        for every slot — mid-run growth never finds the pool empty.
+        (Prefix-shared pages don't disturb it: a shared page is counted by
+        every sharer's reservation, and cache-only pages evict on demand.)
+
+        With any ISSUE 12 feature armed, prefill routes through the chunk
+        program from the prompt's DIVERGENCE point (prefix-cache hit blocks
+        skip their recompute entirely); ``prefill_chunk`` additionally
+        spreads the chunks over engine ticks (:meth:`_chunk_tick`) instead
+        of completing them here."""
         cfg = self.config
         placements = self.batcher.admit()
         for i, (slot, req) in enumerate(placements):
@@ -273,7 +674,12 @@ class Engine:
             self._slot_reserved[slot] = need
             self._reserved_blocks += need
             plen = len(req.prompt)
-            blocks = self.allocator.alloc_many(
+            self._write_cap[slot] = plen + req.max_new_tokens
+            t_admit = time.perf_counter()
+            if self._chunk_armed:
+                self._admit_chunked(slot, req, t_admit, journal)
+                continue
+            blocks = self._alloc_blocks(
                 blocks_for(plen + 1, cfg.block_size))
             self._slot_blocks[slot] = blocks
             row = np.full((self._nb_per_seq,), NULL_BLOCK, np.int32)
@@ -309,6 +715,114 @@ class Engine:
                              "slot": slot, "prompt_len": plen,
                              "ttft_s": req.ttft_s})
 
+    def _admit_chunked(self, slot: int, req: Request, t_admit: float,
+                       journal) -> None:
+        """Seat a request on the chunk-prefill path: prefix-cache lookup
+        first (matched blocks enter the table by reference — their prefill
+        is SKIPPED), then either complete the remaining chunks immediately
+        (``prefill_chunk`` unset) or leave the slot in ``_prefilling`` for
+        :meth:`_chunk_tick` to advance one chunk per engine tick."""
+        plen = len(req.prompt)
+        cached_blocks: List[int] = []
+        n_cached = 0
+        if self.prefix_cache is not None:
+            cached_blocks, n_cached = self.prefix_cache.lookup(req.prompt)
+            # a fully-cached prompt still recomputes its LAST position:
+            # the first generated token needs that position's logits —
+            # and the reuse stat must not count the recomputed token
+            clipped = min(n_cached, plen - 1)
+            self.prefix_cache.tokens_reused -= n_cached - clipped
+            n_cached = clipped
+        req.cached_tokens = n_cached
+        row = np.full((self._nb_per_seq,), NULL_BLOCK, np.int32)
+        row[:len(cached_blocks)] = cached_blocks
+        self._tables[slot] = row
+        self._slot_blocks[slot] = list(cached_blocks)
+        self._prefilling[slot] = {
+            "req": req, "plen": plen, "pos": n_cached, "chunks": 0,
+            "pages_shared": len(cached_blocks),
+            "queue_delay_s": (t_admit - req.arrival_s
+                              if req.arrival_s is not None else None),
+            "cow0": self.cow_forks,
+        }
+        if self.config.prefill_chunk is None:
+            while slot in self._prefilling:
+                self._advance_prefill(slot, journal)
+
+    def _advance_prefill(self, slot: int, journal) -> None:
+        """Run ONE chunk of the slot's prompt through the chunk program
+        (target AND draft caches when speculative decoding is armed); on
+        the last chunk, sample the first token, activate the slot, and
+        register the prompt's full blocks with the prefix cache."""
+        st = self._prefilling[slot]
+        req, plen, pos = st["req"], st["plen"], st["pos"]
+        C = self._chunk_width
+        n = min(C, plen - pos)
+        self._prepare_write_range(slot, pos, n)
+        buf = np.zeros((1, C), np.int32)
+        buf[0, C - n:] = req.prompt[pos:pos + n]
+        row = jnp.asarray(self._tables[slot])
+        tokens = jnp.asarray(buf)
+        start = jnp.asarray(pos, jnp.int32)
+        nv = jnp.asarray(n, jnp.int32)
+        tick = jnp.asarray(2 * self.ticks + 1, jnp.int32)
+        from apex_tpu.monitor import tracing as tracing_mod
+
+        final = pos + n >= plen
+        with tracing_mod.maybe_span(
+                tracing_mod.get_tracer(), "serve.prefill_chunk",
+                cat="compute", slot=slot, start=pos, n_valid=n) as sp:
+            if final:
+                self._k_pages, self._v_pages, tok = self._chunk_fn(
+                    self.params, self._k_pages, self._v_pages, row, tokens,
+                    start, nv, self._base_keys[slot], tick)
+            else:
+                tok = None
+                self._k_pages, self._v_pages = self._chunk_mid_fn(
+                    self.params, self._k_pages, self._v_pages, row, tokens,
+                    start, nv, self._base_keys[slot], tick)
+            if self._draft_chunk_fn is not None:
+                self._dk_pages, self._dv_pages = self._draft_chunk_fn(
+                    self.draft_params, self._dk_pages, self._dv_pages,
+                    row, tokens, start, nv, self._base_keys[slot], tick)
+            sp.barrier(tok if tok is not None else self._k_pages)
+        st["pos"] = pos + n
+        st["chunks"] += 1
+        if not final:
+            return
+        first = int(np.asarray(tok))  # device fetch = TTFT barrier
+        t = time.perf_counter()
+        del self._prefilling[slot]
+        req.tokens.append(first)
+        req.ttft_s = (t - req.arrival_s
+                      if req.arrival_s is not None else None)
+        self._lengths[slot] = plen
+        self._last_token[slot] = first
+        self._active[slot] = True
+        self._last_tok_t[slot] = t
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, self._tables[slot])
+        if journal is not None:
+            journal.log({
+                "kind": "prefill", "request_id": req.request_id,
+                "slot": slot, "prompt_len": plen, "ttft_s": req.ttft_s,
+                "cached_tokens": int(req.cached_tokens),
+                "pages_shared": st["pages_shared"],
+                "chunks": st["chunks"],
+                "queue_delay_s": st["queue_delay_s"],
+                "cow_forks": self.cow_forks - st["cow0"],
+            })
+
+    def _chunk_tick(self, journal) -> None:
+        """Advance ONE prefilling slot by one chunk (FIFO over seating
+        order) — the interleave that keeps a long prompt from freezing
+        running streams: each engine tick costs at most one chunk of
+        prefill on top of the decode step."""
+        if not self._prefilling:
+            return
+        slot = next(iter(self._prefilling))
+        self._advance_prefill(slot, journal)
+
     def _finished(self, req: Request) -> bool:
         eos = self.config.eos_id
         return (len(req.tokens) >= req.max_new_tokens
@@ -321,6 +835,9 @@ class Engine:
             if not self._finished(req):
                 continue
             self.batcher.retire(slot)
+            # drop one reference per held block: freshly-allocated pages
+            # release, prefix-shared pages stay pinned by their remaining
+            # holders — exactly the unshared suffix returns to the pool
             self.allocator.free(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
             self._reserved_blocks -= self._slot_reserved[slot]
@@ -330,6 +847,7 @@ class Engine:
             self._active[slot] = False
             self._last_token[slot] = 0
             self._last_tok_t[slot] = None
+            self._write_cap[slot] = 0
             req.finished_s = now
             results[req.request_id] = req
             if journal is not None:
@@ -343,24 +861,23 @@ class Engine:
                     "e2e_s": round(gen_s, 6),
                 })
 
-    def _ensure_capacity(self, slot: int) -> None:
-        """The next write position must have a page (continuous batching
-        grows a sequence one block at a time, on demand). Cannot fail:
-        the slot's admission reservation covers its whole lifetime
-        (see _admit's invariant)."""
-        pos = int(self._lengths[slot])
-        bi = pos // self.config.block_size
-        if self._tables[slot, bi] == NULL_BLOCK:
-            b = self.allocator.alloc()
-            self._slot_blocks[slot].append(b)
-            self._tables[slot, bi] = b
+    def _decoding(self) -> Dict[int, Request]:
+        """Seated slots that finished prefill and still owe tokens
+        (chunked prefill leaves a slot seated-but-inactive until its last
+        chunk lands; a request completed by that chunk — max_new reached
+        out of prefill — waits for the tick-tail retire instead of
+        decoding past its budget)."""
+        return {s: r for s, r in self.batcher.active.items()
+                if self._active[s] and not self._finished(r)}
 
     def _decode_tick(self, journal) -> None:
-        active = self.batcher.active
+        active = self._decoding()
         if not active:
             return
         for slot in active:
-            self._ensure_capacity(slot)
+            # next write position gets a page (+ COW unsharing) — cannot
+            # fail: the admission reservation covers the whole lifetime
+            self._prepare_write_range(slot, int(self._lengths[slot]), 1)
         if journal is not None:
             journal.step_start()
         from apex_tpu.monitor import tracing as tracing_mod
@@ -388,6 +905,75 @@ class Engine:
                 active_slots=len(active),
                 slot_occupancy=round(self.batcher.occupancy, 4))
 
+    def _spec_tick(self, journal) -> None:
+        """One speculative decode tick: draft proposes K-1 tokens (one
+        jitted scan over the draft cache), the target verifies ALL K fed
+        tokens in one batched K-query forward, and the host commits each
+        slot's longest draft/target greedy agreement plus the bonus token
+        (1..K tokens per tick; EOS and the per-request budget truncate).
+        Rejected positions leave stale k/v beyond the committed length —
+        masked by every later attention and deterministically overwritten
+        when their position is legitimately reached."""
+        active = self._decoding()
+        if not active:
+            return
+        K = self.config.spec_k + 1
+        for slot in active:
+            self._prepare_write_range(slot, int(self._lengths[slot]), K)
+        if journal is not None:
+            journal.step_start()
+        from apex_tpu.monitor import tracing as tracing_mod
+
+        with tracing_mod.maybe_span(
+                tracing_mod.get_tracer(), "serve.spec", cat="compute",
+                tick=self.ticks, active=len(active)) as sp:
+            tables = jnp.asarray(self._tables)
+            lengths = jnp.asarray(self._lengths)
+            act = jnp.asarray(self._active)
+            caps = jnp.asarray(self._write_cap)
+            self._dk_pages, self._dv_pages, xs = self._propose_fn(
+                self.draft_params, self._dk_pages, self._dv_pages,
+                tables, lengths, jnp.asarray(self._last_token), act, caps)
+            self._k_pages, self._v_pages, ys = self._verify_fn(
+                self.params, self._k_pages, self._v_pages,
+                tables, lengths, xs, act, caps)
+            sp.barrier(ys)
+        xs_h = np.asarray(xs)
+        ys_h = np.asarray(ys)  # device fetch stops the clock
+        t = time.perf_counter()
+        accepted = []
+        eos = self.config.eos_id
+        for slot, req in active.items():
+            # commit y_0..y_{a-1}: y_0 is unconditional (it IS the token
+            # sequential decode would emit after the pending token); each
+            # further y_j commits iff draft x_{j} agreed with y_{j-1}
+            a = 1
+            while a < K and xs_h[slot, a] == ys_h[slot, a - 1]:
+                a += 1
+            a = min(a, req.max_new_tokens - len(req.tokens))
+            toks = [int(v) for v in ys_h[slot, :a]]
+            if eos is not None and eos in toks:
+                toks = toks[:toks.index(eos) + 1]
+                a = len(toks)
+            self._lengths[slot] += a
+            req.tokens.extend(toks)
+            self._last_token[slot] = toks[-1]
+            if self._last_tok_t[slot] is not None:
+                dt = t - self._last_tok_t[slot]
+                req.itl_s.extend([dt / a] * a)
+            self._last_tok_t[slot] = t
+            accepted.append(a)
+        self.accepted_total += sum(accepted)
+        self.accept_events += len(accepted)
+        self.spec_ticks += 1
+        if journal is not None:
+            journal.step_end(
+                step=self.ticks, tokens=sum(accepted),
+                queue_depth=self.batcher.queue_depth,
+                active_slots=len(active),
+                slot_occupancy=round(self.batcher.occupancy, 4),
+                accepted_len=round(sum(accepted) / len(accepted), 4))
+
     # -- the serving loop ---------------------------------------------------
 
     def run(self, requests: Optional[Sequence[Request]] = None, *,
@@ -409,7 +995,13 @@ class Engine:
             self._admit(journal)
             # a 1-token request is complete straight out of prefill
             self._retire_finished(journal, results, time.perf_counter())
-            self._decode_tick(journal)
+            # one prefill chunk (if any slot is mid-prompt) rides along
+            # with the decode step — the long-prompt interleave
+            self._chunk_tick(journal)
+            if self.config.spec_k:
+                self._spec_tick(journal)
+            else:
+                self._decode_tick(journal)
             self._retire_finished(journal, results, time.perf_counter())
             self.ticks += 1
             if on_tick is not None:

@@ -7,6 +7,15 @@ prints per-request tokens plus TTFT/ITL latency. TP-sharded decode with
 ``--tp``; sliding-window attention with ``--window``; the same ``--journal``
 / ``--trace`` observability hooks as the trainers.
 
+ISSUE 12 knobs: ``--prefix-cache`` shares matched prompt-prefix KV blocks
+by refcount (COW on divergence), ``--prefill-chunk N`` splits prompts into
+N-token static chunks interleaved with decode ticks, ``--spec-k K`` drafts
+K tokens per tick and verifies them in one batched forward (greedy only;
+``--draft-layers`` builds a smaller randomly-initialized draft — omit it to
+self-draft with the target, which demonstrates full acceptance), and
+``--shared-prefix N`` prepends a common N-token system prompt to every
+synthetic request so the prefix cache has something to share.
+
 Run on 8 virtual devices:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
         python examples/gpt/generate_gpt.py --tp 2 --max-new-tokens 16
@@ -59,6 +68,26 @@ def parse_args():
                    help="0 = greedy; otherwise categorical at this "
                         "temperature with per-slot PRNG keys")
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="share matched prompt-prefix KV blocks between "
+                        "requests (refcounts + copy-on-write; prefill "
+                        "skips to the divergence point)")
+    p.add_argument("--prefill-chunk", type=int, default=None, metavar="N",
+                   help="split prompts into N-token static chunks, one "
+                        "per tick interleaved with decode (a long prompt "
+                        "never stalls running streams)")
+    p.add_argument("--spec-k", type=int, default=0, metavar="K",
+                   help="speculative decoding: K draft tokens per slot "
+                        "per tick, verified in one batched forward "
+                        "(greedy only)")
+    p.add_argument("--draft-layers", type=int, default=None, metavar="L",
+                   help="with --spec-k: build an L-layer randomly-"
+                        "initialized draft model (default: self-draft "
+                        "with the target weights)")
+    p.add_argument("--shared-prefix", type=int, default=0, metavar="N",
+                   help="prepend a common N-token system prompt to every "
+                        "synthetic request (the shared-prefix workload "
+                        "knob for --prefix-cache)")
     p.add_argument("--prompt-file", default=None,
                    help="one request per line, space-separated token ids "
                         "(default: a few synthetic prompts)")
@@ -88,7 +117,8 @@ def load_prompts(args) -> list:
                     prompts.append(toks)
         return prompts
     rng = np.random.default_rng(args.seed)
-    return [list(rng.integers(0, args.vocab, n))
+    shared = list(rng.integers(0, args.vocab, args.shared_prefix))
+    return [shared + list(rng.integers(0, args.vocab, n))
             for n in (5, 12, 3, 9, 17, 7)]
 
 
@@ -135,10 +165,20 @@ def main():
                   "block_size": args.block_size,
                   "window": args.window or 0})
 
+    draft_model = draft_params = None
+    if args.spec_k and args.draft_layers:
+        import dataclasses
+
+        draft_model = GPTModel(dataclasses.replace(
+            cfg, num_layers=args.draft_layers))
+        draft_params = draft_model.init(jax.random.PRNGKey(args.seed + 1))
     engine = Engine(model, params, ServeConfig(
         max_batch=args.max_batch, max_seq=args.max_seq,
         block_size=args.block_size, temperature=args.temperature,
-        top_k=args.top_k, seed=args.seed), mesh=mesh)
+        top_k=args.top_k, seed=args.seed,
+        prefix_cache=args.prefix_cache, prefill_chunk=args.prefill_chunk,
+        spec_k=args.spec_k), mesh=mesh,
+        draft_model=draft_model, draft_params=draft_params)
     prompts = load_prompts(args)
     budget = args.max_seq - args.max_new_tokens
     reqs = [Request(prompt=pr[:max(budget, 1)],
@@ -149,13 +189,19 @@ def main():
     for rid in sorted(results):
         r = results[rid]
         itl_ms = (1e3 * float(np.median(r.itl_s)) if r.itl_s else None)
+        cached = f" | cached {r.cached_tokens} tok" if r.cached_tokens else ""
         print(f"request {rid}: prompt {len(r.prompt)} tok -> "
               f"{len(r.tokens)} new | ttft {1e3 * r.ttft_s:.1f} ms | "
-              f"itl p50 {itl_ms and round(itl_ms, 2)} ms")
+              f"itl p50 {itl_ms and round(itl_ms, 2)} ms{cached}")
         print(f"  tokens: {r.tokens}")
     print(f"{len(results)} request(s) in {engine.ticks} decode tick(s) | "
           f"mesh tp={args.tp} | pool "
           f"{engine.allocator.num_blocks - 1} x {args.block_size} tokens")
+    stats = engine.stats
+    if args.prefix_cache or args.spec_k:
+        print("serving stats: " + ", ".join(
+            f"{k}={v}" for k, v in stats.items()))
+    engine.drop_prefix_cache()
 
     if journal is not None:
         journal.close()

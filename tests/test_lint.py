@@ -982,3 +982,36 @@ def test_decode_recompile_passes_shape_stable_stream():
 
     ok = trace.decode_recompile_hazards(args, ticks=4)
     assert not ok["hazard"] and ok["ticks"] == 4 and ok["leaves"] == 4
+
+
+def test_decode_recompile_audits_extra_streams_both_ways():
+    """ISSUE 12: the extended tripwire audits the chunked-prefill and
+    speculative-verify argument streams by the same rules — clean static
+    streams pass (with per-stream leaf counts), a chunk width that grows
+    with the prompt or a python-int draft length is flagged WITH its
+    stream name (one recompile per request otherwise)."""
+    decode = lambda t: (jnp.zeros((2, 8, 4, 4), jnp.float32),  # noqa: E731
+                        jnp.asarray(t, jnp.int32))
+    chunk_ok = lambda t: (jnp.zeros((1, 16), jnp.int32),       # noqa: E731
+                          jnp.asarray(t * 16, jnp.int32),
+                          jnp.asarray(16, jnp.int32))
+    verify_ok = lambda t: (jnp.zeros((4, 3), jnp.int32),       # noqa: E731
+                           jnp.zeros((4,), jnp.int32))
+    ok = trace.decode_recompile_hazards(
+        decode, ticks=3,
+        extra_streams={"chunk": chunk_ok, "verify": verify_ok})
+    assert not ok["hazard"], ok["findings"][:3]
+    assert ok["stream_leaves"] == {"decode": 2, "chunk": 3, "verify": 2}
+
+    # a chunk buffer that grows with the prompt = a fresh signature per
+    # request; a python-int draft length = weak-typed cache churn
+    bad = trace.decode_recompile_hazards(
+        decode, ticks=2,
+        extra_streams={
+            "chunk": lambda t: (jnp.zeros((1, 16 * (t + 1)), jnp.int32),),
+            "verify": lambda t: (jnp.zeros((4, 3), jnp.int32), 3)})
+    assert bad["hazard"]
+    tagged = {(f["stream"], f["rule"]) for f in bad["findings"]}
+    assert ("chunk", "decode-shape-churn") in tagged, tagged
+    assert ("verify", "recompile-hazard") in tagged, tagged
+    assert all(f["stream"] != "decode" for f in bad["findings"])

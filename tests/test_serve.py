@@ -8,7 +8,20 @@ generated position — serial AND tp=2-sharded, with and without
 allocator / scheduler / sampler, the flash-decode kernel against its dense
 oracle, request-journal robustness under mid-request truncation, and the
 decode-recompile tripwire on the engine's real tick argument stream.
+
+ISSUE 12 extends the gate to production-scale serving: BlockAllocator
+refcount/COW invariants (double-free rejected, shared blocks never mutated
+in place, forked chains release exactly their unshared suffix, zero leaked
+pages under randomized churn), PrefixCache chain lookup/insert/evict, the
+K-query flash-decode verify path against its oracle, prefix-sharing +
+chunked-prefill + speculative engines whose greedy output is IDENTICAL to
+the baseline engine (and to the full-context argmax) serial and tp=2 with
+and without the window, COW isolation between diverging streams, and the
+prefix-hit-rate / accepted-length report rollups with their must_not_drop
+compare gates.
 """
+
+import dataclasses
 
 import numpy as np
 import pytest
@@ -90,6 +103,124 @@ class TestBlockAllocator:
 
     def test_blocks_for(self):
         assert [blocks_for(n, 8) for n in (1, 8, 9, 16, 17)] == [1, 1, 2, 2, 3]
+
+
+class TestRefcountsAndPrefixCache:
+    """ISSUE 12 satellite: allocator refcount/COW invariants + the
+    prefix-cache chain index."""
+
+    def test_incref_defers_release_and_double_free_rejected(self):
+        a = BlockAllocator(6)
+        b = a.alloc()
+        assert a.refcount(b) == 1 and not a.is_shared(b)
+        a.incref(b)
+        assert a.refcount(b) == 2 and a.is_shared(b)
+        a.free([b])  # one holder left: page must NOT return to the pool
+        assert a.refcount(b) == 1 and a.available == 4
+        a.free([b])
+        assert a.refcount(b) == 0 and a.available == 5
+        with pytest.raises(ValueError, match="double free"):
+            a.free([b])
+        with pytest.raises(ValueError):
+            a.incref(b)  # unallocated
+        with pytest.raises(ValueError):
+            a.incref(NULL_BLOCK)
+
+    def test_forked_chain_frees_exactly_the_unshared_suffix(self):
+        """A sequence holding refs on a shared prefix [b0, b1] plus fresh
+        suffix pages [b2, b3]: freeing its chain releases exactly the
+        unshared suffix (2 pages) — the shared prefix stays pinned by the
+        other holder."""
+        a = BlockAllocator(8)
+        shared = a.alloc_many(2)
+        for b in shared:
+            a.incref(b)  # the other holder (e.g. the prefix cache)
+        fresh = a.alloc_many(2)
+        avail0 = a.available
+        a.free(shared + fresh)
+        assert a.available == avail0 + len(fresh)
+        assert all(a.refcount(b) == 1 for b in shared)
+
+    def test_randomized_admit_retire_zero_leaks(self):
+        """Randomized churn over alloc/incref/free interleavings must end
+        with every page back in the pool and every refcount zero."""
+        rng = np.random.default_rng(0)
+        a = BlockAllocator(17)
+        held = []  # flat multiset of references we owe back
+        for _ in range(300):
+            op = rng.integers(0, 3)
+            if op == 0 and a.available:
+                held.append(a.alloc())
+            elif op == 1 and held:
+                held.append(a.incref(int(rng.choice(held))))
+            elif op == 2 and held:
+                i = int(rng.integers(0, len(held)))
+                a.free([held.pop(i)])
+        a.free(held)
+        assert a.available == 16 and a.used == 0
+        assert all(a.refcount(b) == 0 for b in range(1, 17))
+
+    def test_prefix_cache_full_and_partial_lookup(self):
+        from apex_tpu.serve.cache import PrefixCache
+
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, block_size=4)
+        prompt = list(range(10))  # 2 full blocks + ragged tail
+        blocks = a.alloc_many(3)
+        assert pc.insert(prompt, blocks) == 2  # full blocks only
+        assert all(a.refcount(b) == 2 for b in blocks[:2])
+        assert a.refcount(blocks[2]) == 1  # ragged tail never cached
+        # full-block walk
+        got, n = pc.lookup(list(range(8)) + [99, 98])
+        assert n == 8 and got == blocks[:2]
+        assert all(a.refcount(b) == 3 for b in blocks[:2])
+        a.free(got)
+        # PARTIAL match inside the second cached block: first 2 of its 4
+        # tokens agree -> share it, divergence mid-block (the COW case)
+        got, n = pc.lookup([0, 1, 2, 3, 4, 5, 77])
+        assert n == 6 and got == blocks[:2]
+        a.free(got)
+        # no match
+        got, n = pc.lookup([9, 9, 9, 9])
+        assert n == 0 and got == []
+        # re-insert of an existing chain adds nothing (no leaked refs)
+        assert pc.insert(prompt, blocks) == 0
+
+    def test_prefix_cache_eviction_is_leaf_first_and_drop_releases(self):
+        from apex_tpu.serve.cache import PrefixCache
+
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, block_size=4)
+        blocks = a.alloc_many(3)
+        pc.insert(list(range(12)), blocks)
+        a.free(blocks)  # cache is now the only holder
+        assert a.used == 3
+        # evicting 1 page must take a LEAF (deepest chain entry), never a
+        # parent whose child would be stranded mid-walk
+        assert pc.evict(1) == 1
+        got, n = pc.lookup(list(range(12)))
+        assert n == 8 and len(got) == 2  # chain intact through block 1
+        a.free(got)
+        pc.drop()
+        assert a.used == 0
+
+    def test_pool_pressure_evicts_cache_not_correctness(self):
+        """A pool sized so the second request only fits by reclaiming
+        cache-held pages: allocation inside the engine must evict and
+        proceed (no CacheOutOfBlocks escape), tokens stay exact."""
+        model = GPTModel(GPTConfig(axis=None, **BASE))
+        params = model.init(jax.random.PRNGKey(0))
+        eng = Engine(model, params,
+                     ServeConfig(max_batch=1, max_seq=32, block_size=8,
+                                 num_blocks=5, prefix_cache=True))
+        r1 = eng.run([Request(prompt=list(range(9)), max_new_tokens=4,
+                              request_id="a")])
+        assert eng.allocator.used > 0  # cache retains prompt block(s)
+        r2 = eng.run([Request(prompt=list(range(40, 57)), max_new_tokens=8,
+                              request_id="b")])  # needs the whole pool
+        assert_greedy_matches_oracle(model, params, {**r1, **r2})
+        eng.drop_prefix_cache()
+        assert eng.allocator.used == 0
 
 
 class TestContinuousBatcher:
@@ -193,6 +324,50 @@ class TestFlashDecode:
         with pytest.raises(ValueError):
             flash_decode(q, kp, vp, jnp.zeros((1, 2), jnp.int32),
                          jnp.zeros((1,), jnp.int32))
+
+    @pytest.mark.parametrize("window", [None, 5])
+    def test_multi_query_pallas_matches_reference(self, window):
+        """The K-query verify path (ISSUE 12): the Pallas kernel in
+        interpret mode matches the dense oracle, and every trailing query
+        row equals a SINGLE-query decode at its own shifted length — the
+        exactness speculative verification rests on."""
+        from apex_tpu.ops.flash_decode import (
+            flash_decode_multi, paged_attention_multi_reference)
+
+        kp, vp = self._pages()
+        rng = np.random.default_rng(6)
+        K = 3
+        q = jnp.asarray(rng.normal(size=(3, 4, K, 16)), jnp.float32)
+        tables = jnp.asarray(
+            rng.permutation(np.arange(1, 13)).reshape(3, 4), jnp.int32)
+        lengths = jnp.asarray([17, 0, 32], jnp.int32)  # incl. idle slot
+        ref = paged_attention_multi_reference(q, kp, vp, tables, lengths,
+                                              window=window)
+        ker = flash_decode_multi(q, kp, vp, tables, lengths, window=window,
+                                 impl="pallas")
+        np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                                   atol=1e-5)
+        assert np.allclose(np.asarray(ref[1]), 0.0)  # idle slot: exact 0
+        for j in range(K):
+            lj = jnp.maximum(lengths - (K - 1 - j), 0)
+            single = paged_attention_reference(q[:, :, j], kp, vp, tables,
+                                               lj, window=window)
+            np.testing.assert_allclose(np.asarray(ref[:, :, j]),
+                                       np.asarray(single), atol=1e-5)
+
+    def test_multi_query_k1_equals_single(self):
+        from apex_tpu.ops.flash_decode import flash_decode_multi
+
+        kp, vp = self._pages()
+        rng = np.random.default_rng(8)
+        q = jnp.asarray(rng.normal(size=(2, 4, 16)), jnp.float32)
+        tables = jnp.asarray([[3, 1, 7, 2], [4, 5, 6, 8]], jnp.int32)
+        lengths = jnp.asarray([19, 11], jnp.int32)
+        one = flash_decode(q, kp, vp, tables, lengths)
+        multi = flash_decode_multi(q[:, :, None, :], kp, vp, tables,
+                                   lengths)[:, :, 0]
+        np.testing.assert_allclose(np.asarray(one), np.asarray(multi),
+                                   atol=1e-6)
 
 
 # ---------------------------------------------------------------------------
@@ -308,6 +483,192 @@ class TestEngineEquivalence:
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 12: prefix sharing, chunked prefill, speculative decoding
+# ---------------------------------------------------------------------------
+
+
+class TestProductionServing:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        model = GPTModel(GPTConfig(axis=None, **BASE))
+        params = model.init(jax.random.PRNGKey(0))
+        baseline = Engine(model, params,
+                          ServeConfig(max_batch=2, max_seq=48, block_size=8))
+        base_res = baseline.run(make_requests())
+        return model, params, base_res
+
+    def test_chunked_prefill_matches_monolithic(self, setup):
+        """Chunked prefill is a pure scheduling change: the same prompts
+        split into 4-token static chunks must produce IDENTICAL token
+        streams to the monolithic-prefill engine."""
+        model, params, base_res = setup
+        eng = Engine(model, params,
+                     ServeConfig(max_batch=2, max_seq=48, block_size=8,
+                                 prefill_chunk=4))
+        res = eng.run(make_requests())
+        for rid in base_res:
+            assert base_res[rid].tokens == res[rid].tokens, rid
+        assert eng.allocator.used == 0 and eng.batcher.idle
+
+    def test_chunked_prefill_interleaves_with_decode(self, setup):
+        """A long prompt admitted while a short request decodes must NOT
+        stall the short stream: its tokens keep arriving during the long
+        prompt's chunk ticks (the ITL-protection structure chunking
+        exists for), and both streams stay exact."""
+        model, params, _ = setup
+        eng = Engine(model, params,
+                     ServeConfig(max_batch=2, max_seq=48, block_size=8,
+                                 prefill_chunk=4))
+        rng = np.random.default_rng(3)
+        short = Request(prompt=list(rng.integers(0, 61, 4)),
+                        max_new_tokens=12, request_id="short")
+        long_p = Request(prompt=list(rng.integers(0, 61, 30)),
+                         max_new_tokens=4, request_id="long")
+        eng.submit(short)
+        seen = []
+
+        def inject(engine):
+            # long prompt arrives once the short stream is running
+            if engine.ticks == 2:
+                engine.submit(long_p)
+            seen.append((engine.ticks, len(short.tokens),
+                         bool(engine._prefilling)))
+
+        res = eng.run(journal=None, on_tick=inject)
+        assert_greedy_matches_oracle(model, params, res)
+        # the short stream generated during the long prompt's chunk ticks
+        progressed = [n for t, n, prefilling in seen if prefilling]
+        assert progressed and progressed[-1] > progressed[0], seen
+
+    def test_prefix_sharing_skips_to_divergence(self, setup):
+        """Second request with a shared prompt prefix: cached_tokens >=
+        the shared full blocks, pages are shared by reference, tokens
+        stay exact, and zero pages leak once the cache drops."""
+        model, params, _ = setup
+        eng = Engine(model, params,
+                     ServeConfig(max_batch=2, max_seq=48, block_size=8,
+                                 prefix_cache=True))
+        rng = np.random.default_rng(5)
+        base = list(rng.integers(0, 61, 16))
+        res = eng.run([Request(prompt=base + [1, 2, 3], max_new_tokens=5,
+                               request_id="a"),
+                       Request(prompt=base + [4, 5], max_new_tokens=5,
+                               request_id="b")])
+        assert_greedy_matches_oracle(model, params, res)
+        assert res["a"].cached_tokens == 0
+        assert res["b"].cached_tokens >= 16
+        assert eng.stats["tokens_reused"] >= 16
+        eng.drop_prefix_cache()
+        assert eng.allocator.used == 0 and eng.batcher.idle
+
+    def test_cow_isolates_diverging_streams(self, setup):
+        """Divergence INSIDE a cached block COW-forks it: a request
+        diverging mid-block (and a fully-matched request recomputing its
+        last position) must fork rather than mutate, so a concurrent
+        stream sharing those pages emits exactly its solo token stream."""
+        model, params, _ = setup
+        eng = Engine(model, params,
+                     ServeConfig(max_batch=3, max_seq=48, block_size=8,
+                                 prefix_cache=True))
+        rng = np.random.default_rng(11)
+        A = list(rng.integers(0, 61, 16))
+        solo = eng.run([Request(prompt=A, max_new_tokens=8,
+                                request_id="A")])
+        res = eng.run([
+            Request(prompt=A, max_new_tokens=8, request_id="A2"),
+            Request(prompt=A[:12] + [7, 9], max_new_tokens=6,
+                    request_id="B"),  # diverges mid-block -> fork
+            Request(prompt=A, max_new_tokens=6, request_id="C"),
+        ])
+        assert_greedy_matches_oracle(model, params, res)
+        assert res["A2"].tokens == solo["A"].tokens  # never perturbed
+        assert res["B"].cached_tokens == 12
+        assert eng.cow_forks >= 2, eng.cow_forks
+        eng.drop_prefix_cache()
+        assert eng.allocator.used == 0
+
+    @pytest.mark.parametrize("window", [None, 8])
+    def test_speculative_greedy_is_exact(self, window):
+        """The acceptance-criteria core: greedy speculative output ==
+        non-speculative engine == full-context argmax at every position,
+        with and without the sliding window, for a perfect (self) draft
+        AND a disagreeing random draft."""
+        cfg = GPTConfig(axis=None, attention_window=window, **BASE)
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        scfg = ServeConfig(max_batch=2, max_seq=48, block_size=8)
+        base_res = Engine(model, params, scfg).run(make_requests())
+        spec = Engine(model, params,
+                      dataclasses.replace(scfg, spec_k=3))
+        res = spec.run(make_requests())
+        assert_greedy_matches_oracle(model, params, res)
+        for rid in base_res:
+            assert base_res[rid].tokens == res[rid].tokens, rid
+        # a perfect draft accepts the full k+1 every tick
+        assert spec.stats["mean_accepted_len"] > 1.5, spec.stats
+        draft = GPTModel(dataclasses.replace(cfg, num_layers=1))
+        dparams = draft.init(jax.random.PRNGKey(9))
+        spec2 = Engine(model, params, dataclasses.replace(scfg, spec_k=2),
+                       draft_model=draft, draft_params=dparams)
+        res2 = spec2.run(make_requests())
+        for rid in base_res:
+            assert base_res[rid].tokens == res2[rid].tokens, rid
+
+    def test_speculative_tp2_matches_serial(self):
+        """Sharded half of the speculative gate: a TP=2 speculative engine
+        (self-draft, chunked prefill + prefix cache riding along) emits
+        the serial non-speculative engine's exact streams."""
+        from apex_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.make_virtual_mesh(8, tensor_model_parallel_size=2)
+        try:
+            base = dict(BASE, vocab_size=64)
+            model_s = GPTModel(GPTConfig(axis=None, **base))
+            model_tp = GPTModel(GPTConfig(axis=mesh_lib.AXIS_MODEL, **base))
+            params = model_s.init(jax.random.PRNGKey(0))
+            res_s = Engine(model_s, params,
+                           ServeConfig(max_batch=2, max_seq=48,
+                                       block_size=8)).run(
+                make_requests(vocab=64))
+            eng = Engine(model_tp, params,
+                         ServeConfig(max_batch=2, max_seq=48, block_size=8,
+                                     spec_k=2, prefill_chunk=8,
+                                     prefix_cache=True), mesh=mesh)
+            res_tp = eng.run(make_requests(vocab=64))
+            for rid in res_s:
+                assert res_s[rid].tokens == res_tp[rid].tokens, rid
+            eng.drop_prefix_cache()
+            assert eng.allocator.used == 0
+        finally:
+            mesh_lib.destroy_model_parallel()
+
+    def test_spec_requires_greedy(self):
+        model = GPTModel(GPTConfig(axis=None, **BASE))
+        with pytest.raises(ValueError, match="temperature"):
+            Engine(model, {}, ServeConfig(spec_k=2, temperature=0.7))
+
+    def test_one_token_budget_through_every_path(self, setup):
+        """A max_new_tokens=1 request completes straight out of chunked
+        prefill — the tick that finished its chunk must NOT decode it
+        past its budget (speculative commit with a zero budget would
+        otherwise underflow)."""
+        model, params, _ = setup
+        eng = Engine(model, params,
+                     ServeConfig(max_batch=2, max_seq=48, block_size=8,
+                                 prefix_cache=True, prefill_chunk=4,
+                                 spec_k=2))
+        res = eng.run([Request(prompt=list(range(9)), max_new_tokens=1,
+                               request_id="one"),
+                       Request(prompt=[2, 7], max_new_tokens=4,
+                               request_id="more")])
+        assert len(res["one"].tokens) == 1
+        assert len(res["more"].tokens) == 4
+        assert_greedy_matches_oracle(model, params, res)
+        eng.drop_prefix_cache()
+        assert eng.allocator.used == 0
+
+
+# ---------------------------------------------------------------------------
 # journaling, report rollup, tripwire
 # ---------------------------------------------------------------------------
 
@@ -407,3 +768,85 @@ class TestServeObservability:
         tw = lint_trace.decode_recompile_hazards(eng.decode_args, ticks=3)
         assert not tw["hazard"], tw["findings"][:3]
         assert tw["leaves"] > 0
+
+
+class TestProductionServingObservability:
+    """ISSUE 12 satellite: prefix/chunk/spec journal rollups + their
+    must_not_drop compare gates + the extended recompile tripwire."""
+
+    @pytest.fixture(scope="class")
+    def served(self, tmp_path_factory):
+        from apex_tpu.monitor.journal import MetricsJournal
+
+        path = str(tmp_path_factory.mktemp("serve12") / "serve.jsonl")
+        model = GPTModel(GPTConfig(axis=None, **BASE))
+        params = model.init(jax.random.PRNGKey(0))
+        eng = Engine(model, params,
+                     ServeConfig(max_batch=2, max_seq=48, block_size=8,
+                                 prefix_cache=True, prefill_chunk=4,
+                                 spec_k=2))
+        rng = np.random.default_rng(5)
+        base = list(rng.integers(0, 61, 16))
+        with MetricsJournal(path, meta={"run": "test_serve12"}) as j:
+            results = eng.run(
+                [Request(prompt=base + [1, 2, 3], max_new_tokens=5,
+                         request_id="a"),
+                 Request(prompt=base + [4, 5], max_new_tokens=5,
+                         request_id="b"),
+                 Request(prompt=base + [4, 5, 6], max_new_tokens=4,
+                         request_id="c")],
+                journal=j)
+        return path, eng, results
+
+    def test_rollups_cover_sharing_chunks_and_acceptance(self, served):
+        from apex_tpu.monitor import report
+        from apex_tpu.monitor.journal import MetricsJournal
+
+        path, eng, results = served
+        rows = MetricsJournal.read(path)
+        pf = [r for r in rows if r["kind"] == "prefill"]
+        assert pf and all("cached_tokens" in r and "chunks" in r
+                          and "queue_delay_s" in r for r in pf)
+        assert any(r["cached_tokens"] > 0 for r in pf)  # later reqs hit
+        sv = report.analyze(rows).get("serving")
+        assert sv and sv["requests"] == len(results) == 3
+        assert sv["prefix_hit_rate"] > 0
+        assert sv["pages_saved"] > 0
+        assert sv["prefill_chunks"] >= sum(r["chunks"] for r in pf)
+        assert "prefill_queue_delay_ms" in sv
+        assert sv["accepted_len"]["p50"] > 1  # self-draft agrees
+
+    def test_compare_gates_hit_rate_and_accepted_length(self, served):
+        """must_not_drop both ways: self-compare passes; a candidate with
+        sharing silently dropped / a disagreeing draft regresses."""
+        from apex_tpu.monitor import report
+        from apex_tpu.monitor.journal import MetricsJournal
+
+        path, _, _ = served
+        rows = MetricsJournal.read(path)
+        assert report.compare(rows, rows, threshold=0.05)["ok"]
+        worse = []
+        for r in rows:
+            r2 = dict(r)
+            if r2.get("kind") == "prefill":
+                r2["cached_tokens"] = 0
+                r2["pages_shared"] = 0
+            if "accepted_len" in r2:
+                r2["accepted_len"] = 1.0
+            worse.append(r2)
+        res = report.compare(rows, worse, threshold=0.05)
+        assert not res["ok"]
+        assert {"prefix_hit_rate", "accepted_len_p50"} <= set(
+            res["regressed"]), res["regressed"]
+
+    def test_extended_tripwire_audits_chunk_and_verify_streams(self, served):
+        from apex_tpu.lint import trace as lint_trace
+
+        _, eng, _ = served
+        tw = lint_trace.decode_recompile_hazards(
+            eng.decode_args, ticks=3,
+            extra_streams={"chunk": eng.chunk_args,
+                           "verify": eng.spec_args})
+        assert not tw["hazard"], tw["findings"][:3]
+        assert tw["stream_leaves"]["chunk"] > 0
+        assert tw["stream_leaves"]["verify"] > 0
