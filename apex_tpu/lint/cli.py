@@ -1,9 +1,10 @@
 """``python -m apex_tpu.lint`` -- run the source-invariant linter.
 
-Engine 1 only: the trace analyzers (``apex_tpu.lint.trace``) need a live
-step function and example args, so they ship as an API (wired into
-``monitor.selftest`` and the ``benchmarks/gpt_scaling.py`` per-config
-report) rather than a file-walking CLI mode.
+Engine 1 only: the trace analyzers (``apex_tpu.lint.trace``) and the IR
+passes (``apex_tpu.lint.passes``) need a live step function and example
+args, so they ship as an API (wired into ``monitor.selftest``, the
+``benchmarks/gpt_scaling.py`` per-config report, and the step-audit gate
+``python -m apex_tpu.lint.audit``) rather than a file-walking CLI mode.
 
 Usage::
 
@@ -12,7 +13,8 @@ Usage::
     python -m apex_tpu.lint path/to/file.py  # lint specific files/dirs
     python -m apex_tpu.lint --rules comm-scope,grad-collective
     python -m apex_tpu.lint --list-rules
-    python -m apex_tpu.lint --json           # one JSON line (CI artifact)
+    python -m apex_tpu.lint --format json    # findings as a JSON array (CI)
+    python -m apex_tpu.lint --json           # legacy one-line summary JSON
 
 No reference analog (see package docstring).
 """
@@ -20,13 +22,24 @@ No reference analog (see package docstring).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import Optional, Sequence
 
 from apex_tpu.lint.rules_source import DEFAULT_TREES, RULES, run_paths
 
+_STRICT_DOC = (
+    "exit 1 if any unsuppressed violation remains; suppressed findings "
+    "(a '# lint: disable=<rule> -- why' on the flagged line, the line "
+    "above, or file-wide) never fail strict mode, and tier-1 "
+    "(tests/test_lint.py) additionally rejects suppressions without a "
+    "justification -- so CI green means: every invariant holds, every "
+    "waiver is self-documenting")
+
 
 def _list_rules(out) -> None:
+    from apex_tpu.lint import ir as ir_mod
+
     width = max(len(n) for n in RULES) + 2
     print("source rules (engine 1, suppress with "
           "'# lint: disable=<rule> -- why'):", file=out)
@@ -44,6 +57,17 @@ def _list_rules(out) -> None:
                              "python-scalar leakage in the jit signature"),
     ):
         print(f"  {name:<{width}}{what}", file=out)
+    try:
+        import apex_tpu.lint.passes  # noqa: F401 - registration
+    except Exception:  # noqa: BLE001 - passes need no jax, but be safe
+        return
+    print("\nIR passes (engine 3, shared single-trace walker -- "
+          "apex_tpu.lint.ir.run_passes / python -m apex_tpu.lint.audit; "
+          "suppress at the finding's provenance line with the same "
+          "grammar):", file=out)
+    w = max((len(n) for n in ir_mod.PASS_REGISTRY), default=0) + 2
+    for name in sorted(ir_mod.PASS_REGISTRY):
+        print(f"  {name:<{w}}{ir_mod.PASS_REGISTRY[name][1]}", file=out)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -51,19 +75,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="python -m apex_tpu.lint",
         description="apex_tpu project-invariant linter (engine 1: source "
                     "AST rules; see --list-rules for the trace-analyzer "
-                    "API).")
+                    "and IR-pass APIs).",
+        epilog=f"--strict semantics: {_STRICT_DOC}.")
     p.add_argument("paths", nargs="*",
                    help=f"files/dirs to lint (default: the "
                         f"{'/'.join(DEFAULT_TREES)} trees)")
-    p.add_argument("--strict", action="store_true",
-                   help="exit 1 if any unsuppressed violation remains (CI)")
+    p.add_argument("--strict", action="store_true", help=_STRICT_DOC)
     p.add_argument("--rules", type=str, default=None,
                    help="comma-separated rule subset")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json: print the findings as a JSON array of "
+                        "{rule, file, line, message[, suppressed, "
+                        "justification]} objects -- the machine interface "
+                        "for CI/driver consumers (no text scraping)")
     p.add_argument("--json", action="store_true",
-                   help="print one JSON line instead of per-line findings")
+                   help="legacy one-line summary JSON (counts + findings "
+                        "under one object); prefer --format json")
     p.add_argument("--show-suppressed", action="store_true",
-                   help="also print suppressed findings with justifications")
+                   help="also print suppressed findings with justifications"
+                        " (--format json always includes them, marked)")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -77,7 +108,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(str(e), file=sys.stderr)
         return 2
 
-    if args.json:
+    if args.format == "json":
+        rows = []
+        for f in report.findings:
+            row = {"rule": f.rule, "file": f.path, "line": f.line,
+                   "message": f.message}
+            if f.suppressed:
+                row["suppressed"] = True
+                row["justification"] = f.justification
+            rows.append(row)
+        print(json.dumps(rows))
+    elif args.json:
         print(report.to_json())
     else:
         for f in report.findings:

@@ -1,0 +1,39 @@
+"""Registered whole-program jaxpr analysis passes (engine 3).
+
+Importing this package registers every built-in pass with
+``apex_tpu.lint.ir.PASS_REGISTRY`` (the ``register_pass`` decorator); the
+shared single-trace walker (:mod:`apex_tpu.lint.ir`) then runs any subset
+over ONE materialized walk of a step program — ``python -m
+apex_tpu.lint.audit`` runs all of them over the repo's canonical step
+programs. Pass-author guide: ``apex_tpu/lint/passes/README.md``.
+
+- ``collective-consistency`` — collective sequences agree across
+  ``lax.cond``/``switch`` branches inside shard_map bodies; ppermute
+  permutations are well-formed rings; axis names resolve (the static
+  deadlock / mismatched-ppermute detector).
+- ``static-hbm``      — live-range peak-bytes estimate under the Mosaic
+  T(8,128) lane-padding model, plus lane-padded blowups at custom-call
+  boundaries (the ``(b, h, sq, 1)`` 128x tax).
+- ``dtype-drift``     — model-sized wide-float intermediates that start
+  AND end narrow with no genuine fp32 state involved (the silent 2x
+  HBM/wire regression class).
+- ``comm-bytes``      — statically derived bytes-per-(verb, wire dtype)
+  from collective equations, reconciled against the same trace's
+  ``CommAccount.by_verb_dtype`` books (unbooked traffic = a verb missing
+  its ``comm:`` scope).
+
+No reference analog: the reference ships no static analysis
+(apex_tpu/lint/__init__.py).
+"""
+
+from apex_tpu.lint.passes import collective_consistency  # noqa: F401
+from apex_tpu.lint.passes import comm_bytes  # noqa: F401
+from apex_tpu.lint.passes import dtype_drift  # noqa: F401
+from apex_tpu.lint.passes import static_hbm  # noqa: F401
+
+from apex_tpu.lint.passes.collective_consistency import (  # noqa: F401
+    collective_consistency_pass,
+)
+from apex_tpu.lint.passes.comm_bytes import comm_bytes_pass  # noqa: F401
+from apex_tpu.lint.passes.dtype_drift import dtype_drift_pass  # noqa: F401
+from apex_tpu.lint.passes.static_hbm import static_hbm_pass  # noqa: F401

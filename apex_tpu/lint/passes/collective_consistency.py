@@ -1,0 +1,161 @@
+"""collective-consistency pass: SPMD collective structure as a checked fact.
+
+Inside a ``shard_map`` body every rank executes the same program, so the
+program itself must guarantee that ranks agree on WHICH collectives run and
+with WHAT geometry — XLA compiles the disagreements silently and the job
+deadlocks (or silently mis-routes) at runtime on a multi-host mesh. veScale
+(PAPERS.md, arxiv 2509.07003) makes the case that this consistency should
+be verified by the framework; this pass verifies three static facts over
+the shared walk (:mod:`apex_tpu.lint.ir`):
+
+1. **branch agreement** — the collective sequence (verb, axes, permutation)
+   of every ``lax.cond``/``switch`` branch matches its siblings': a
+   data-dependent predicate that is not provably replicated may diverge
+   across ranks, and a rank entering the branch with the extra psum waits
+   forever on the ranks that took the other arm.
+2. **well-formed ppermutes** — a permutation with a duplicated source or
+   destination (two ranks sending to one slot), or an endpoint outside the
+   bound axis size, is the mismatched-ppermute class the pipeline ring and
+   ring attention must never regress into.
+3. **bound axis names** — a collective over an axis name that no enclosing
+   shard_map (or root ``axes=`` binding) binds fails only at run/lowering
+   time on the real mesh; named here with provenance instead.
+
+No reference analog: the reference ships no static analysis
+(apex_tpu/lint/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from apex_tpu.lint import ir as ir_mod
+
+RULE = "collective-consistency"
+
+
+def _perm_of(eqn) -> Optional[Tuple[Tuple[int, int], ...]]:
+    perm = eqn.params.get("perm")
+    if perm is None:
+        return None
+    return tuple((int(a), int(b)) for a, b in perm)
+
+
+def _collective_signature(jaxpr) -> Tuple[Tuple[str, Tuple[str, ...],
+                                                Optional[tuple]], ...]:
+    """Ordered (verb, axes, perm) sequence of every collective in a branch
+    body, descending into nested sub-jaxprs (nested conds contribute the
+    union of their own branches' signatures positionally — a disagreement
+    below still surfaces as a disagreement here)."""
+    out: List[Tuple[str, Tuple[str, ...], Optional[tuple]]] = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in ir_mod.COLLECTIVE_PRIMS:
+                out.append((eqn.primitive.name, ir_mod.eqn_axis_names(eqn),
+                            _perm_of(eqn)))
+            for sub in ir_mod.sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return tuple(out)
+
+
+def _finding(node, message: str, **extra) -> Dict[str, Any]:
+    f = {"rule": RULE, "message": message, **extra}
+    src = node.source()
+    if src:
+        f["path"], f["line"] = src
+    return f
+
+
+def collective_consistency_pass(ir, *, check_axis_binding: bool = True,
+                                max_findings: int = 20) -> Dict[str, Any]:
+    """Run the three checks over one shared walk. Returns ``{findings,
+    conds_checked, ppermutes_checked, collectives}``; findings beyond
+    ``max_findings`` are counted in ``findings_truncated``, never dropped
+    silently."""
+    ir = ir_mod.ensure_ir(ir)
+    findings: List[Dict[str, Any]] = []
+    conds = ppermutes = n_collectives = 0
+
+    for node in ir.nodes:
+        eqn = node.eqn
+        name = eqn.primitive.name
+
+        if name == "cond" and node.in_shard_map:
+            branches = eqn.params.get("branches") or ()
+            sigs = [_collective_signature(
+                br.jaxpr if hasattr(br, "jaxpr") else br)
+                for br in branches]
+            if any(sigs):
+                conds += 1
+            if len(set(sigs)) > 1:
+                detail = "; ".join(
+                    f"branch {i}: {[f'{v}@{list(a)}' for v, a, _ in s] or 'none'}"
+                    for i, s in enumerate(sigs))
+                findings.append(_finding(
+                    node,
+                    f"lax.cond branches inside a shard_map body disagree on "
+                    f"their collective sequence ({detail}) -- ranks whose "
+                    f"predicate diverges deadlock on the unmatched "
+                    f"collective; hoist the collective out of the cond or "
+                    f"make every branch issue the same sequence",
+                    kind="branch-divergence"))
+
+        if name not in ir_mod.COLLECTIVE_PRIMS:
+            continue
+        n_collectives += 1
+        axes = ir_mod.eqn_axis_names(eqn)
+
+        if check_axis_binding and node.axis_sizes:
+            unbound = [a for a in axes if a not in node.axis_sizes]
+            if unbound:
+                findings.append(_finding(
+                    node,
+                    f"{name} over axis {unbound} which no enclosing "
+                    f"shard_map (bound: {sorted(node.axis_sizes)}) binds -- "
+                    f"this fails only at lowering time on the real mesh",
+                    kind="unbound-axis"))
+
+        if name == "ppermute":
+            ppermutes += 1
+            perm = _perm_of(eqn) or ()
+            srcs = [s for s, _ in perm]
+            dsts = [d for _, d in perm]
+            problems = []
+            if len(set(srcs)) != len(srcs):
+                problems.append("duplicated source (one rank sends twice)")
+            if len(set(dsts)) != len(dsts):
+                problems.append(
+                    "duplicated destination (two ranks send to one slot)")
+            size = None
+            for a in axes:
+                if a in node.axis_sizes:
+                    size = int(node.axis_sizes[a])
+            if size is not None and any(
+                    not (0 <= i < size) for i in srcs + dsts):
+                problems.append(
+                    f"endpoint outside the axis size {size}")
+            if problems:
+                findings.append(_finding(
+                    node,
+                    f"ppermute over {list(axes)} with a malformed "
+                    f"permutation ({'; '.join(problems)}): perm={list(perm)}"
+                    f" -- the conjugate ring (parallel/collectives."
+                    f"ppermute_shift) must stay a bijection",
+                    kind="malformed-ppermute", perm=list(map(list, perm))))
+
+    truncated = max(0, len(findings) - max_findings)
+    return {"findings": findings[:max_findings],
+            "findings_truncated": truncated,
+            "conds_checked": conds,
+            "ppermutes_checked": ppermutes,
+            "collectives": n_collectives}
+
+
+ir_mod.register_pass(
+    RULE,
+    "collective sequences agree across cond/switch branches in shard_map "
+    "bodies; ppermute rings are bijections; axis names resolve")(
+        collective_consistency_pass)

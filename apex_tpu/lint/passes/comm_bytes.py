@@ -1,0 +1,129 @@
+"""comm-bytes pass: static wire bytes reconciled against the comm books.
+
+Every collective verb in this repo runs under a ``comm:<verb>[<axis>]``
+scope (``monitor/comms.py``) so ``CommAccount`` books its payload bytes
+per (verb, axis, wire dtype) at trace time — the accounting the evidence
+harnesses, the journal timeline, and the quantized-wire claims all read.
+The books are only as complete as the scopes: a new subsystem that calls
+``lax.psum`` directly moves real wire bytes the accounting never sees
+(the engine-1 ``comm-scope`` source rule polices the canonical modules;
+this pass closes the loop at the IR level, where the actual collective
+equations are).
+
+Over the shared walk (:mod:`apex_tpu.lint.ir`) the pass derives a static
+bytes-per-(verb, wire-dtype) table from the collective equations (operand
+payload bytes, call sites per trace — the same convention the books use)
+and reconciles it against ``CommAccount.by_verb_dtype`` from the SAME
+single trace (``trace_ir(comm=True)`` attaches it). The checked
+invariant: any wire dtype moving bulk static bytes with ZERO booked bytes
+is unbooked traffic — a collective bypassed its ``comm:`` scope. Static
+totals legitimately EXCEED booked ones on differentiated steps (AD
+transposes emit conjugate collectives with no scope of their own), so
+only the all-or-nothing per-dtype check findings; the full tables ride
+the result for evidence consumers.
+
+No reference analog: the reference ships no static analysis
+(apex_tpu/lint/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from apex_tpu.lint import ir as ir_mod
+
+RULE = "comm-bytes"
+
+
+def static_verb_dtype_table(ir) -> Dict[str, Dict[str, int]]:
+    """``{"<prim>[<dtype>]": {"bytes", "calls"}}`` from the collective
+    equations of one shared walk — operand payload bytes per call site,
+    the ``CommAccount.by_verb_dtype`` shape (``pmean`` lowers to
+    ``psum``+div, so compare per-DTYPE totals across the two tables, not
+    verb names)."""
+    import numpy as np
+
+    out: Dict[str, Dict[str, int]] = {}
+    for node in ir_mod.ensure_ir(ir).collectives():
+        eqn = node.eqn
+        nbytes = 0
+        dtypes = set()
+        for v in eqn.invars:
+            aval = ir_mod.aval_of(v)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            nbytes += ir_mod.aval_bytes(aval)
+            try:
+                dtypes.add(str(np.dtype(aval.dtype)))
+            except Exception:  # noqa: BLE001 - tokens carry no dtype
+                continue
+        if not dtypes:
+            dtype = "none"
+        elif len(dtypes) == 1:
+            dtype = dtypes.pop()
+        else:
+            dtype = "mixed"
+        key = f"{eqn.primitive.name}[{dtype}]"
+        row = out.setdefault(key, {"bytes": 0, "calls": 0})
+        row["bytes"] += nbytes
+        row["calls"] += 1
+    return out
+
+
+def _by_dtype(table: Dict[str, Dict[str, int]]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for key, row in table.items():
+        dtype = key.rsplit("[", 1)[-1].rstrip("]")
+        out[dtype] = out.get(dtype, 0) + int(row["bytes"])
+    return out
+
+
+def comm_bytes_pass(ir, *, min_report_bytes: int = 1 << 16,
+                    account=None) -> Dict[str, Any]:
+    """Reconcile static collective bytes against the booked accounting.
+
+    ``account`` overrides the IR's attached ``comm_account`` (a
+    :class:`apex_tpu.monitor.comms.CommAccount` filled during the same
+    trace). Without either, the pass reports the static table only and
+    raises no findings (there is nothing to reconcile). A finding fires
+    per wire dtype whose static bytes reach ``min_report_bytes`` while
+    the books hold ZERO bytes at that dtype — bulk traffic the
+    ``comm:``-scope accounting never saw.
+    """
+    ir = ir_mod.ensure_ir(ir)
+    static = static_verb_dtype_table(ir)
+    account = account if account is not None else ir.comm_account
+    booked = account.by_verb_dtype() if account is not None else None
+    findings: List[Dict[str, Any]] = []
+    if booked is not None:
+        booked_dtype = _by_dtype(booked)
+        for dtype, sbytes in sorted(_by_dtype(static).items()):
+            if dtype == "none" or sbytes < min_report_bytes:
+                continue
+            if booked_dtype.get(dtype, 0) == 0:
+                findings.append({
+                    "rule": RULE, "dtype": dtype, "static_bytes": sbytes,
+                    "message": (
+                        f"the step's jaxpr moves {sbytes} collective "
+                        f"payload bytes at wire dtype {dtype} but the "
+                        f"comm accounting booked ZERO bytes there -- a "
+                        f"collective verb bypassed its comm:<verb> scope "
+                        f"(monitor/comms.collective_scope); route it "
+                        f"through parallel/collectives.py so per-axis "
+                        f"byte attribution stays complete"),
+                })
+    return {
+        "findings": findings,
+        "static_by_verb_dtype": static,
+        "booked_by_verb_dtype": booked,
+        "static_total_bytes": sum(r["bytes"] for r in static.values()),
+        "booked_total_bytes": (account.total_bytes()
+                               if account is not None else None),
+    }
+
+
+ir_mod.register_pass(
+    RULE,
+    "static bytes-per-(verb, wire dtype) from collective eqns reconciled "
+    "against CommAccount.by_verb_dtype books (unbooked traffic flags)")(
+        comm_bytes_pass)

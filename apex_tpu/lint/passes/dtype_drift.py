@@ -1,0 +1,179 @@
+"""dtype-drift pass: silent wide-float intermediates in a narrow step.
+
+The regression class: a step requested at bf16 (O2 compute policy) grows a
+MODEL-SIZED fp32 intermediate through a stray upcast — ``jnp.float32(2) *
+x`` where ``2.0 * x`` was meant, a helper that normalizes in fp32 and
+forgets to come back down, a weak-type promotion that sticks. XLA compiles
+it silently and the activation (or its wire payload) doubles.
+
+The discriminator, run as a forward taint analysis per jaxpr body over the
+shared walk (:mod:`apex_tpu.lint.ir`):
+
+- an upcast (``convert_element_type`` narrow-float -> wide-float) of a
+  large value marks its result TAINTED — fp32 bytes that exist only
+  because of the upcast;
+- taint propagates through equations UNLESS some other operand is an
+  ANCHORED wide float (an untainted non-scalar wide value — genuine fp32
+  state: masters, Adam moments, an fp32 LN weight). Wide compute that
+  touches real fp32 state is intentional mixed-precision; wide compute
+  that starts narrow and involves none is drift;
+- a finding fires when a large TAINTED value converts back DOWN to a
+  narrow float (the round-trip completed: that compute ran at 2x bytes
+  for nothing) — with provenance of both the downcast and the upcast that
+  started it, so an intentional widening (fp32 softmax for numerics) is
+  suppressed at its source line with the standard
+  ``# lint: disable=dtype-drift -- why`` idiom.
+
+Each body is analyzed independently with its own inputs treated as
+anchored (conservative: cross-body flows never false-positive).
+
+No reference analog: the reference ships no static analysis
+(apex_tpu/lint/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from apex_tpu.lint import ir as ir_mod
+
+RULE = "dtype-drift"
+
+_NARROW_BITS = 16
+_WIDE_BITS = 32
+
+
+def _float_bits(aval) -> Optional[int]:
+    import numpy as np
+
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return None
+    try:
+        if not (np.issubdtype(dtype, np.floating)
+                or str(dtype) == "bfloat16"):
+            return None
+        return int(np.dtype(dtype).itemsize) * 8
+    except Exception:  # noqa: BLE001 - exotic dtypes are out of scope
+        return None
+
+
+def _size(aval) -> int:
+    return int(getattr(aval, "size", 0) or 0)
+
+
+def _analyze_body(jaxpr, *, min_elems: int,
+                  findings: List[Dict[str, Any]],
+                  stats: Dict[str, int]) -> None:
+    # var id -> source (file, line) of the upcast that tainted it
+    tainted: Dict[int, Optional[Tuple[str, int]]] = {}
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        for sub in ir_mod.sub_jaxprs(eqn):
+            _analyze_body(sub, min_elems=min_elems, findings=findings,
+                          stats=stats)
+
+        in_avals = [(v, ir_mod.aval_of(v)) for v in eqn.invars]
+        out_avals = [(v, ir_mod.aval_of(v)) for v in eqn.outvars]
+
+        if name == "convert_element_type" and in_avals and out_avals:
+            src_v, src_a = in_avals[0]
+            dst_v, dst_a = out_avals[0]
+            src_bits, dst_bits = _float_bits(src_a), _float_bits(dst_a)
+            if src_bits is None or dst_bits is None:
+                continue
+            if (src_bits <= _NARROW_BITS and dst_bits >= _WIDE_BITS
+                    and _size(dst_a) >= min_elems):
+                # large upcast: the taint origin
+                tainted[id(dst_v)] = ir_mod.eqn_source(eqn)
+                stats["upcasts"] += 1
+                stats["upcast_bytes"] += ir_mod.aval_bytes(dst_a)
+                continue
+            if (src_bits >= _WIDE_BITS and dst_bits <= _NARROW_BITS
+                    and _size(src_a) >= min_elems
+                    and id(src_v) in tainted):
+                origin = tainted[id(src_v)]
+                f: Dict[str, Any] = {
+                    "rule": RULE,
+                    "shape": list(getattr(src_a, "shape", ())),
+                    "dtype": str(getattr(src_a, "dtype", "")),
+                    "bytes": ir_mod.aval_bytes(src_a),
+                    "message": (
+                        f"a {tuple(getattr(src_a, 'shape', ()))} "
+                        f"{getattr(src_a, 'dtype', '')} intermediate was "
+                        f"upcast from a narrow float and converts straight "
+                        f"back down -- that compute ran at 2x the bytes "
+                        f"with no fp32 state involved (silent dtype "
+                        f"drift); keep it narrow, or waive the widening "
+                        f"at its source with '# lint: disable="
+                        f"{RULE} -- why' if the fp32 excursion is "
+                        f"intentional numerics"),
+                }
+                src = origin or ir_mod.eqn_source(eqn)
+                if src:
+                    f["path"], f["line"] = src
+                    f["origin"] = list(src)
+                down = ir_mod.eqn_source(eqn)
+                if down:
+                    f["downcast"] = list(down)
+                findings.append(f)
+                continue
+
+        # propagation: outputs are tainted iff some wide input is tainted
+        # and NO wide input is anchored (untainted, non-scalar)
+        tainted_in: Optional[Tuple[str, int]] = None
+        has_tainted = anchored = False
+        for v, a in in_avals:
+            bits = _float_bits(a)
+            if bits is None or bits < _WIDE_BITS:
+                continue
+            if not ir_mod.is_literal(v) and id(v) in tainted:
+                has_tainted = True
+                tainted_in = tainted_in or tainted[id(v)]
+            elif not ir_mod.is_literal(v) and _size(a) > 1:
+                anchored = True
+        if has_tainted and not anchored:
+            for v, a in out_avals:
+                bits = _float_bits(a)
+                if bits is not None and bits >= _WIDE_BITS:
+                    tainted[id(v)] = tainted_in
+
+
+def dtype_drift_pass(ir, *, min_elems: int = 1 << 15,
+                     max_findings: int = 20) -> Dict[str, Any]:
+    """Taint-track wide-float round-trips over one shared walk.
+
+    ``min_elems`` is the "model-sized" floor: both the upcast that starts
+    a taint and the downcast that fires a finding must move at least this
+    many elements (default 32Ki — activation-sized at the audited
+    configs; scalars and per-row stats never fire). Returns ``{findings,
+    upcasts, upcast_bytes, findings_truncated}`` with per-(path, line)
+    dedup so a remat/vjp re-trace of the same source site reports once.
+    """
+    ir = ir_mod.ensure_ir(ir)
+    findings: List[Dict[str, Any]] = []
+    stats = {"upcasts": 0, "upcast_bytes": 0}
+    _analyze_body(ir.jaxpr, min_elems=min_elems, findings=findings,
+                  stats=stats)
+    deduped: List[Dict[str, Any]] = []
+    seen = set()
+    for f in findings:
+        key = (f.get("path"), f.get("line"), tuple(f.get("shape", ())),
+               f.get("dtype"))
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(f)
+    deduped.sort(key=lambda f: -f.get("bytes", 0))
+    truncated = max(0, len(deduped) - max_findings)
+    return {"findings": deduped[:max_findings],
+            "findings_truncated": truncated,
+            "upcasts": stats["upcasts"],
+            "upcast_bytes": stats["upcast_bytes"]}
+
+
+ir_mod.register_pass(
+    RULE,
+    "model-sized wide-float intermediates that start and end narrow with "
+    "no fp32 state involved (silent 2x HBM/wire drift)")(dtype_drift_pass)

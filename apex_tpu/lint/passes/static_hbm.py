@@ -1,0 +1,216 @@
+"""static-hbm pass: live-range peak-bytes estimate + lane-padding blowups.
+
+Two HBM facts this repo has paid for on chip (CLAUDE.md gotchas,
+PERF_NOTES.md) become whole-program checks over the shared walk
+(:mod:`apex_tpu.lint.ir`):
+
+1. **peak residency estimate** — a live-range scan over the step program:
+   walk each jaxpr body in order, birth a value's bytes at its defining
+   equation, free them after its last use (never freeing the body's
+   outputs), and recurse into call-like equations by charging the inner
+   body's peak OVER its operands at the call point. Reported both logical
+   and under the Mosaic T(8,128) tiling model (minor dim -> 128 lanes,
+   second-minor -> ``32/itemsize`` sublanes; ``monitor.hbm.
+   lane_padded_bytes``, the same rule ``ops/flash_attention.py``
+   calibrates). An ESTIMATE, deliberately conservative: XLA fuses
+   intermediates and schedules frees earlier, so the figure upper-bounds
+   the placed footprint — cross-checkable against ``monitor.hbm``'s
+   measured ``live_array_stats`` (the audit and tests pin the ratio
+   within 2x).
+2. **lane-padded blowups** — every operand/result of a custom-call
+   boundary (``pallas_call`` et al.) and the step signature audited for
+   the padding tax: a ``(b, h, sq, 1)`` f32 operand occupies 128x its
+   ``nbytes`` at such a boundary (2 GB for 16 MB of lse at 512k tokens —
+   the measured tax that forced the streamed kernels' dense lse tables).
+
+No reference analog: the reference ships no static analysis
+(apex_tpu/lint/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from apex_tpu.lint import ir as ir_mod
+
+RULE = "static-hbm"
+
+
+def _var_bytes(var) -> Tuple[int, int]:
+    """(logical, lane-padded) bytes of one jaxpr variable; (0, 0) for
+    literals/tokens."""
+    if ir_mod.is_literal(var):
+        return 0, 0
+    aval = ir_mod.aval_of(var)
+    if aval is None:
+        return 0, 0
+    return (ir_mod.aval_bytes(aval, padded=False),
+            ir_mod.aval_bytes(aval, padded=True))
+
+
+def _jaxpr_peak(jaxpr) -> Tuple[int, int]:
+    """(peak logical, peak padded) bytes of one body via live-range scan.
+
+    Inputs/consts live from entry; each equation births its outputs at its
+    program point; a value dies after its last consuming equation unless
+    it is a body output. A call-like equation charges, at its point, the
+    inner body's peak minus the inner inputs (those bytes are the
+    operands, already live here) — the transient the call adds above its
+    arguments. cond charges the worst branch.
+    """
+    last_use: Dict[int, int] = {}
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not ir_mod.is_literal(v):
+                last_use[id(v)] = idx
+    never_free = {id(v) for v in jaxpr.outvars if not ir_mod.is_literal(v)}
+
+    live = live_pad = 0
+    sizes: Dict[int, Tuple[int, int]] = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        if id(v) in sizes:
+            continue
+        nb, pb = _var_bytes(v)
+        sizes[id(v)] = (nb, pb)
+        live += nb
+        live_pad += pb
+    peak, peak_pad = live, live_pad
+
+    for idx, eqn in enumerate(jaxpr.eqns):
+        inner_extra = inner_extra_pad = 0
+        for sub in ir_mod.sub_jaxprs(eqn):
+            sp, spp = _jaxpr_peak(sub)
+            sub_in = sum(_var_bytes(v)[0] for v in sub.invars)
+            sub_in_pad = sum(_var_bytes(v)[1] for v in sub.invars)
+            inner_extra = max(inner_extra, sp - sub_in)
+            inner_extra_pad = max(inner_extra_pad, spp - sub_in_pad)
+        out_b = out_pb = 0
+        for v in eqn.outvars:
+            nb, pb = _var_bytes(v)
+            sizes[id(v)] = (nb, pb)
+            # an output nothing ever consumes (DropVar) dies on the spot
+            last_use.setdefault(id(v), idx)
+            out_b += nb
+            out_pb += pb
+        if eqn.primitive.name in ("scan", "while"):
+            # stacked loop outputs accumulate WHILE the body's transients
+            # are live: charge both
+            point, point_pad = out_b + inner_extra, out_pb + inner_extra_pad
+        else:
+            # a plain call's (pjit/cond/remat/custom_vjp) inner peak
+            # already holds the outputs at body end — max, not sum, or
+            # every nested jit double-books its own results
+            point = max(out_b, inner_extra)
+            point_pad = max(out_pb, inner_extra_pad)
+        peak = max(peak, live + max(point, 0))
+        peak_pad = max(peak_pad, live_pad + max(point_pad, 0))
+        live += out_b
+        live_pad += out_pb
+        freed = set()
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if ir_mod.is_literal(v):
+                continue
+            vid = id(v)
+            if (vid not in freed and last_use.get(vid) == idx
+                    and vid not in never_free and vid in sizes):
+                freed.add(vid)
+                nb, pb = sizes.pop(vid)
+                live -= nb
+                live_pad -= pb
+    return peak, peak_pad
+
+
+def _audit_boundary_aval(aval, where: str, threshold: float,
+                         min_bytes: int) -> Dict[str, Any]:
+    """One lane-padding blowup finding, or None (the trace.py
+    ``_audit_aval`` rule, emitted under this pass's name)."""
+    nb = ir_mod.aval_bytes(aval, padded=False)
+    pb = ir_mod.aval_bytes(aval, padded=True)
+    if getattr(aval, "size", 0) <= 1:
+        return None  # a scalar cannot avoid its one tile; pure noise
+    if nb <= 0 or pb < threshold * nb or (pb - nb) < min_bytes:
+        return None
+    shape = tuple(int(d) for d in aval.shape)
+    hint = ""
+    if shape and shape[-1] == 1:
+        hint = ("; carry per-row stats as dense (rows, blk) tables, not "
+                "(rows, 1) columns (flash_attention.py lse/delta)")
+    elif shape and shape[-1] < 128:
+        hint = ("; prefer minor dims that are multiples of 128 (e.g. "
+                "head_dim 128 at extreme sequence lengths)")
+    return {
+        "rule": RULE, "where": where, "shape": list(shape),
+        "dtype": str(aval.dtype), "bytes": nb, "padded_bytes": pb,
+        "waste_ratio": round(pb / nb, 2),
+        "message": (f"{where}: {shape} {aval.dtype} occupies {pb} bytes "
+                    f"under T(8,128) tiling ({round(pb / nb, 1)}x its {nb})"
+                    f"{hint}"),
+    }
+
+
+def static_hbm_pass(ir, *, threshold: float = 2.0,
+                    min_bytes: int = 1 << 16,
+                    max_findings: int = 20) -> Dict[str, Any]:
+    """Peak-bytes estimate + boundary lane-padding findings over one
+    shared walk. Returns ``{peak_bytes, peak_padded_bytes,
+    resident_in_bytes, resident_out_bytes, findings, audited,
+    findings_truncated}`` — findings sorted by wasted bytes, worst first.
+    """
+    ir = ir_mod.ensure_ir(ir)
+    jaxpr = ir.jaxpr
+    peak, peak_pad = _jaxpr_peak(jaxpr)
+    res_in = sum(_var_bytes(v)[0] for v in jaxpr.invars)
+    res_out = sum(_var_bytes(v)[0] for v in jaxpr.outvars)
+
+    findings: List[Dict[str, Any]] = []
+    audited = 0
+    seen = set()
+
+    def audit(var, where, node=None):
+        nonlocal audited
+        aval = ir_mod.aval_of(var)
+        if aval is None or not hasattr(aval, "shape"):
+            return
+        key = (where, tuple(aval.shape), str(aval.dtype))
+        if key in seen:
+            return
+        seen.add(key)
+        audited += 1
+        f = _audit_boundary_aval(aval, where, threshold, min_bytes)
+        if f is not None:
+            if node is not None:
+                src = node.source()
+                if src:
+                    f["path"], f["line"] = src
+            findings.append(f)
+
+    for i, v in enumerate(jaxpr.invars):
+        audit(v, f"input[{i}]")
+    for i, v in enumerate(jaxpr.outvars):
+        audit(v, f"output[{i}]")
+    for node in ir.nodes:
+        name = node.eqn.primitive.name
+        if name not in ir_mod.BOUNDARY_PRIMS:
+            continue
+        for v in node.eqn.invars:
+            audit(v, f"{name} operand", node)
+        for v in node.eqn.outvars:
+            audit(v, f"{name} result", node)
+
+    findings.sort(key=lambda f: f["bytes"] - f["padded_bytes"])
+    truncated = max(0, len(findings) - max_findings)
+    return {
+        "peak_bytes": int(peak),
+        "peak_padded_bytes": int(peak_pad),
+        "resident_in_bytes": int(res_in),
+        "resident_out_bytes": int(res_out),
+        "findings": findings[:max_findings],
+        "findings_truncated": truncated,
+        "audited": audited,
+    }
+
+
+ir_mod.register_pass(
+    RULE,
+    "live-range peak-bytes estimate under the T(8,128) tiling model + "
+    "lane-padded blowups at custom-call boundaries")(static_hbm_pass)

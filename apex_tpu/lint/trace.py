@@ -68,6 +68,13 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
 All analyzers are trace-time only (``jax.make_jaxpr``; no compile, no
 device work) and return plain dicts/lists of findings shaped like engine
 1's (rule/message), so CLI and journal consumers render them uniformly.
+
+Since ISSUE 13 every analyzer here runs on the SHARED single-trace walker
+(:mod:`apex_tpu.lint.ir`): ``fn`` may be a callable (traced once), a
+pre-traced ``ClosedJaxpr``, or a :class:`apex_tpu.lint.ir.StepIR` — hand
+the same StepIR to N analyzers and the step traces and walks exactly once
+(the audit gate and tests/test_lint.py's module-scoped fixtures do).
+Public signatures are unchanged.
 """
 
 from __future__ import annotations
@@ -75,6 +82,7 @@ from __future__ import annotations
 from collections import Counter
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from apex_tpu.lint import ir as _ir
 from apex_tpu.monitor.hbm import lane_padded_bytes
 
 
@@ -96,31 +104,16 @@ def _num_lanes() -> int:
 def _sub_jaxprs(eqn) -> List[Any]:
     """Every inner jaxpr of a call-like equation (pjit, scan, while, cond,
     shard_map, custom_vjp, pallas_call, ...) -- all branches, no multipliers:
-    these analyzers report presence/residency, not totals per step."""
-    import jax
-
-    out = []
-
-    def collect(v):
-        if isinstance(v, jax.extend.core.ClosedJaxpr):
-            out.append(v.jaxpr)
-        elif hasattr(v, "eqns"):  # open Jaxpr (remat, pallas_call)
-            out.append(v)
-        elif isinstance(v, (list, tuple)):
-            for item in v:
-                collect(item)
-
-    for v in eqn.params.values():
-        collect(v)
-    return out
+    these analyzers report presence/residency, not totals per step.
+    (Delegates to the shared walker, apex_tpu/lint/ir.py.)"""
+    return _ir.sub_jaxprs(eqn)
 
 
 def iter_eqns(jaxpr) -> Iterable[Any]:
-    """Depth-first over every equation, descending into inner jaxprs."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for sub in _sub_jaxprs(eqn):
-            yield from iter_eqns(sub)
+    """Depth-first over every equation, descending into inner jaxprs —
+    the shared walk (:mod:`apex_tpu.lint.ir`): a ``StepIR``, ClosedJaxpr,
+    or open jaxpr walks once and the node list is cached/reused."""
+    return _ir.ensure_ir(jaxpr).iter_eqns()
 
 
 def _aval_of(var):
@@ -211,13 +204,8 @@ def lane_padding_report(fn, *args,
     findings sorted by wasted bytes, worst first; ``findings_truncated``
     counts drops beyond ``max_findings`` (never silently).
     """
-    import jax
-
-    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
-        jaxpr = fn.jaxpr
-    else:
-        env = list(axes.items()) if axes else None
-        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    ir = _ir.trace_ir(fn, *args, axes=axes, **kwargs)
+    jaxpr = ir.jaxpr
     findings: List[Dict[str, Any]] = []
     audited = 0
     seen = set()
@@ -240,7 +228,7 @@ def lane_padding_report(fn, *args,
         audit(v, f"input[{i}]")
     for i, v in enumerate(jaxpr.outvars):
         audit(v, f"output[{i}]")
-    for eqn in iter_eqns(jaxpr):
+    for eqn in ir.iter_eqns():
         name = eqn.primitive.name
         if name not in _BOUNDARY_PRIMS:
             continue
@@ -298,12 +286,11 @@ def transpose_hazards(loss_fn, *args,
     """
     import jax
 
-    env = list(axes.items()) if axes else None
     fwd = scalar_collective_counts(
-        jax.make_jaxpr(loss_fn, axis_env=env)(*args, **kwargs).jaxpr)
+        _ir.trace_ir(loss_fn, *args, axes=axes, **kwargs))
     grad_fn = jax.value_and_grad(loss_fn, argnums=argnums)
     bwd = scalar_collective_counts(
-        jax.make_jaxpr(grad_fn, axis_env=env)(*args, **kwargs).jaxpr)
+        _ir.trace_ir(grad_fn, *args, axes=axes, **kwargs))
     extra = {k: bwd[k] - fwd.get(k, 0) for k in bwd
              if bwd[k] > fwd.get(k, 0)}
     findings = [{
@@ -326,17 +313,8 @@ def transpose_hazards(loss_fn, *args,
 # the primitive names an eqn binds its axis under, per collective family
 _AXIS_PARAM_KEYS = ("axes", "axis_name")
 
-
-def _eqn_axis_names(eqn) -> Tuple[str, ...]:
-    """Named axes a collective equation reduces/moves over (psum binds
-    ``axes``; all_gather/reduce_scatter/all_to_all bind ``axis_name``)."""
-    for key in _AXIS_PARAM_KEYS:
-        if key in eqn.params:
-            v = eqn.params[key]
-            if isinstance(v, (tuple, list)):
-                return tuple(str(a) for a in v)
-            return (str(v),)
-    return ()
+# shared with the IR walker so the two can never disagree on the binding
+_eqn_axis_names = _ir.eqn_axis_names
 
 
 def tp_collective_census(jaxpr, tp_axis: str,
@@ -391,15 +369,9 @@ def sequence_parallel_hazards(fn, *args,
     with ``num_layers`` omitted (the "all-reduce count per layer 2 -> 0"
     evidence number, benchmarks/overlap_evidence.py).
     """
-    import jax
-
-    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
-        jaxpr = fn.jaxpr
-    else:
-        env = list(axes.items()) if axes else None
-        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
-    census = tp_collective_census(jaxpr, tp_axis,
-                                  min_activation_rank=min_activation_rank)
+    jaxpr = _ir.trace_ir(fn, *args, axes=axes, **kwargs)
+    census = tp_collective_census(
+        jaxpr, tp_axis, min_activation_rank=min_activation_rank)
     n_psum = sum(n for verb, n in census["activation"].items()
                  if verb in ("psum", "pmean"))
     findings = []
@@ -480,15 +452,9 @@ def zero_redundancy_hazards(fn, *args,
     Returns ``{hazard, census, bulk_psums, findings}`` — call-site counts
     per trace, like :func:`sequence_parallel_hazards`.
     """
-    import jax
-
-    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
-        jaxpr = fn.jaxpr
-    else:
-        env = list(axes.items()) if axes else None
-        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
-    census = zero_collective_census(jaxpr, zero_axis,
-                                    min_bulk_elems=min_bulk_elems)
+    jaxpr = _ir.trace_ir(fn, *args, axes=axes, **kwargs)
+    census = zero_collective_census(
+        jaxpr, zero_axis, min_bulk_elems=min_bulk_elems)
     n_psum = sum(n for verb, n in census["bulk"].items()
                  if verb in ("psum", "pmean"))
     findings = []
@@ -583,16 +549,10 @@ def zero3_gather_hazards(fn, *args,
     Returns ``{hazard, census, bulk_gathers, layer_gathers, findings}`` —
     call-site counts per trace, like :func:`zero_redundancy_hazards`.
     """
-    import jax
-
     if min_model_elems is None:
         min_model_elems = (max(int(bulk_fraction * model_elems), 1)
                            if model_elems else 1 << 22)
-    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
-        jaxpr = fn.jaxpr
-    else:
-        env = list(axes.items()) if axes else None
-        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    jaxpr = _ir.trace_ir(fn, *args, axes=axes, **kwargs)
     census = param_gather_census(jaxpr, zero_axis, min_model_elems)
     n_bulk = sum(census["bulk"].values())
     findings = []
@@ -623,8 +583,8 @@ def zero3_gather_hazards(fn, *args,
 # ---------------------------------------------------------------------------
 
 #: primitives that open a rematerialized region (jax.checkpoint lowers to
-#: remat2 on this jax; older/newer spellings kept for robustness)
-_REMAT_PRIMS = ("remat", "remat2", "checkpoint")
+#: remat2 on this jax; shared with the IR walker)
+_REMAT_PRIMS = _ir.REMAT_PRIMS
 
 
 def prefetch_gather_census(jaxpr, zero_axis: str) -> Dict[str, int]:
@@ -634,26 +594,20 @@ def prefetch_gather_census(jaxpr, zero_axis: str) -> Dict[str, int]:
     backward's recompute and pinned to that body's schedule) or stands
     FREE in the surrounding jaxpr (the double-buffered drive's
     structurally prefetchable form, ``models/_transformer.
-    _prefetched_zero3_drive``). Counts are call sites per trace."""
+    _prefetched_zero3_drive``). Counts are call sites per trace; remat
+    containment comes from the shared walk's context
+    (:class:`apex_tpu.lint.ir.EqnNode.in_remat`)."""
     fused = free = regions = 0
-
-    def walk(jx, in_remat):
-        nonlocal fused, free, regions
-        for eqn in jx.eqns:
-            name = eqn.primitive.name
-            if (name == "all_gather"
-                    and zero_axis in _eqn_axis_names(eqn)):
-                if in_remat:
-                    fused += 1
-                else:
-                    free += 1
-            sub_remat = in_remat or name in _REMAT_PRIMS
-            if name in _REMAT_PRIMS:
-                regions += 1
-            for sub in _sub_jaxprs(eqn):
-                walk(sub, sub_remat)
-
-    walk(jaxpr, False)
+    for node in _ir.ensure_ir(jaxpr).nodes:
+        name = node.eqn.primitive.name
+        if name in _REMAT_PRIMS:
+            regions += 1
+        if (name == "all_gather"
+                and zero_axis in _eqn_axis_names(node.eqn)):
+            if node.in_remat:
+                fused += 1
+            else:
+                free += 1
     return {"fused": fused, "free": free, "remat_regions": regions}
 
 
@@ -686,13 +640,7 @@ def unprefetched_gather_hazards(fn, *args,
     free_gathers, findings}`` — call-site counts per trace, like
     :func:`zero3_gather_hazards`.
     """
-    import jax
-
-    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
-        jaxpr = fn.jaxpr
-    else:
-        env = list(axes.items()) if axes else None
-        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
+    jaxpr = _ir.trace_ir(fn, *args, axes=axes, **kwargs)
     census = prefetch_gather_census(jaxpr, zero_axis)
     findings = []
     if census["fused"] >= min_fused:
@@ -782,15 +730,9 @@ def quantized_comm_hazards(fn, *args,
     Returns ``{hazard, census, fat_reduces, findings}`` — call-site counts
     per trace, like :func:`zero_redundancy_hazards`.
     """
-    import jax
-
-    if hasattr(fn, "jaxpr"):  # a ClosedJaxpr
-        jaxpr = fn.jaxpr
-    else:
-        env = list(axes.items()) if axes else None
-        jaxpr = jax.make_jaxpr(fn, axis_env=env)(*args, **kwargs).jaxpr
-    census = quantized_comm_census(jaxpr, zero_axis,
-                                   min_bulk_elems=min_bulk_elems)
+    jaxpr = _ir.trace_ir(fn, *args, axes=axes, **kwargs)
+    census = quantized_comm_census(
+        jaxpr, zero_axis, min_bulk_elems=min_bulk_elems)
     fat = sum(n for size, verbs in census.items() if int(size) > 1
               for n in verbs.values())
     thin = sum(n for size, verbs in census.items() if int(size) == 1

@@ -34,6 +34,12 @@ that the monitor pieces stay importable and functional:
    (per-layer ZeRO-3 gathers fused inside rematerialized bodies flag;
    the double-buffered free-standing gathers pass).
 
+8b. audit: the whole-program step-audit gate (``apex_tpu.lint.audit``,
+   ISSUE 13) runs every registered IR pass + tripwire over the small
+   dense and zero canonical train steps on the shared single-trace
+   walker and the verdict is clean — same contract as
+   ``python -m apex_tpu.lint.audit`` over the full program set;
+
 9. tracing: nested spans round-trip with depths and strict-JSON
    non-finite handling; a torn trace file still parses; the analytic
    bubble floors and the step-anatomy fraction invariant (compute +
@@ -690,6 +696,34 @@ def _check_serve() -> dict:
             "spec_accepted_mean": eng2.stats["mean_accepted_len"]}
 
 
+def _check_audit() -> dict:
+    """The whole-program step-audit gate (ISSUE 13): every registered IR
+    pass (collective-consistency / static-hbm / dtype-drift / comm-bytes)
+    plus the program-relevant tripwires over the small dense + zero
+    canonical train steps, each traced ONCE on the shared walker
+    (apex_tpu.lint.ir) — the same verdict `python -m apex_tpu.lint.audit`
+    emits, gating all_ok here so telemetry CI fails the moment a step
+    program stops auditing clean."""
+    from apex_tpu.lint import audit as lint_audit
+    from apex_tpu.lint import ir as ir_mod
+
+    verdict = lint_audit.run_audit(programs=("dense", "zero"))
+    assert verdict["all_ok"], verdict
+    dense = verdict["programs"]["dense"]
+    # the passes actually ran over a real walk, not a vacuous one
+    assert set(dense["passes"]) == set(ir_mod.PASS_REGISTRY), dense
+    cc = dense["passes"]["collective-consistency"]
+    assert cc["collectives"] > 0 and cc["ppermutes_checked"] > 0, cc
+    hbm = dense["passes"]["static-hbm"]
+    assert hbm["peak_bytes"] >= hbm["resident_in_bytes"] > 0, hbm
+    zero = verdict["programs"]["zero"]
+    assert not zero["tripwires"]["zero-redundancy"]["hazard"], zero
+    return {"ok": True, "programs": sorted(verdict["programs"]),
+            "errors": verdict["errors"],
+            "suppressed": verdict["suppressed"],
+            "dense_peak_bytes": hbm["peak_bytes"]}
+
+
 def run() -> dict:
     """In-process smoke (no platform mutation — safe under any backend)."""
     results = {}
@@ -701,6 +735,7 @@ def run() -> dict:
                      ("diagnose", _check_diagnose),
                      ("report", _check_report),
                      ("lint", _check_lint),
+                     ("audit", _check_audit),
                      ("tracing", _check_tracing),
                      ("serve", _check_serve)):
         try:
@@ -716,6 +751,12 @@ def run() -> dict:
 def main() -> int:
     # standalone runs must stay off any ambient accelerator plugin (the
     # axon tunnel ignores JAX_PLATFORMS env; force in code, CLAUDE.md)
+    # and need the 8-device virtual CPU mesh for the audit check's
+    # canonical step programs (same env shaping as lint.audit's main)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     try:
         import jax
 

@@ -515,6 +515,19 @@ class Engine:
                 jnp.asarray(self._active), self._base_keys,
                 jnp.asarray(2 * tick, jnp.int32))
 
+    def prefill_args(self, tick: int):
+        """The EXACT argument tuple a monolithic prefill launch ships at
+        tick ``tick`` (the :meth:`_admit` call site) — the provenance hook
+        the step-audit gate (``apex_tpu.lint.audit``) traces the prefill
+        program with; same shape-stability contract as
+        :meth:`decode_args` (prefills fold odd values into the key)."""
+        cfg = self.config
+        return (self.params, self._k_pages, self._v_pages,
+                jnp.asarray(self._tables[0]),
+                jnp.zeros((1, cfg.prefill_len), jnp.int32),
+                jnp.asarray(0, jnp.int32), self._base_keys[0],
+                jnp.asarray(2 * tick + 1, jnp.int32))
+
     def chunk_args(self, tick: int):
         """The EXACT argument tuple a chunked-prefill launch ships at tick
         ``tick`` — the second input stream the extended

@@ -1,7 +1,14 @@
 """Tests for apex_tpu.lint — the project-invariant linter (engine 1: source
 AST rules) and the jaxpr hazard analyzers (engine 2: lane padding,
 collective-transpose, recompile hazards) — plus the tier-1 contract that the
-repo itself lints clean with every suppression justified."""
+repo itself lints clean with every suppression justified.
+
+The REAL-step tripwire tests share module-scoped StepIR fixtures (ISSUE
+13): each canonical step callable traces ONCE on the shared walker
+(apex_tpu.lint.ir) and the same IR feeds every analyzer that reads it —
+the dedupe that measurably cut this module's wall time (PERF_NOTES.md).
+The IR walker and pass framework have their own suite in
+tests/test_lint_ir.py."""
 
 import json
 import textwrap
@@ -11,6 +18,7 @@ import pytest
 from jax import lax
 
 from apex_tpu.lint import RULES, Suppressions, comm_scope_check, run_paths
+from apex_tpu.lint import ir as lint_ir
 from apex_tpu.lint import trace
 from apex_tpu.lint.cli import main as lint_main
 
@@ -20,6 +28,140 @@ def _write(tmp_path, relpath, body):
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(textwrap.dedent(body))
     return path
+
+
+# ---------------------------------------------------------------------------
+# module-scoped step IRs: each real step callable traces ONCE, every
+# analyzer below reads the same shared walk
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def zero3_gpt_irs():
+    """StepIRs of the REAL fully-sharded (ZeRO-3) GPT drives: the
+    serialized unrolled chunk_meta step (zero3_prefetch=0), the
+    double-buffered drive (=1), and the bulk whole-stack-gather
+    regression — one ``value_and_grad`` trace each for the whole
+    module."""
+    import jax
+
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.distributed import (
+        gather_chunked_tree,
+        gather_stacked_leaf,
+    )
+
+    base = dict(vocab_size=64, hidden_size=16, num_layers=4,
+                num_attention_heads=2, max_seq_len=8, hidden_dropout=0.0,
+                axis=None, unroll_layers=True)
+    params = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype),
+        jax.eval_shape(GPTModel(GPTConfig(**base)).init,
+                       jax.random.PRNGKey(0)))
+    mp_opt = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-3), amp.get_policy("O2"),
+        zero_axis="data", zero_level=3)
+    meta = mp_opt.zero3_meta(params)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jnp.zeros((2, 8), jnp.int32)
+
+    def loss_fn(prefetch):
+        model = GPTModel(GPTConfig(zero3_prefetch=prefetch, **base))
+
+        def fn(p):
+            chunks = mp_opt.zero3_shard(p)
+            rest = gather_chunked_tree(
+                {k: v for k, v in chunks.items() if k != "layers"},
+                rest_meta)
+            return model.loss(dict(rest, layers=chunks["layers"]),
+                              toks, toks, layer_chunk_meta=layer_meta)
+        return fn
+
+    def bulk_loss(p):
+        chunks = mp_opt.zero3_shard(p)
+        layers = jax.tree.map(
+            lambda c, s: gather_stacked_leaf(c, s.shape, s.dtype, "data"),
+            chunks["layers"], layer_meta.shapes,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        return GPTModel(GPTConfig(**base)).loss(
+            dict(rest, layers=layers), toks, toks)
+
+    def mk(fn):
+        return lint_ir.trace_ir(jax.value_and_grad(fn), params,
+                                axes={"data": 8})
+
+    return {"serialized": mk(loss_fn(0)), "prefetched": mk(loss_fn(1)),
+            "bulk": mk(bulk_loss), "num_layers": base["num_layers"]}
+
+
+@pytest.fixture(scope="module")
+def gpt_sp_forward_irs():
+    """StepIRs of the plain-TP and sequence-parallel GPT forwards — the
+    model-level SP regression gate's two traces, shared module-wide."""
+    import jax
+
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    tiny = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                num_attention_heads=4, max_seq_len=16, hidden_dropout=0.0,
+                compute_dtype=jnp.float32, remat=False)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    irs = {}
+    for sp in (False, True):
+        model = GPTModel(GPTConfig(axis="model", sequence_parallel=sp,
+                                   **tiny))
+        params = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype),
+            jax.eval_shape(model.init, jax.random.PRNGKey(0)))
+        irs[sp] = lint_ir.trace_ir(
+            lambda p, t, m=model: m.apply(p, t, jnp.roll(t, -1, -1)),
+            params, toks, axes={"model": 2})
+    return irs
+
+
+@pytest.fixture(scope="module")
+def zero_amp_step_irs():
+    """StepIRs of the real MixedPrecisionOptimizer steps the redundancy
+    and quantized-wire tripwires pin: the ZeRO LAMB step, the replicated
+    twin, the int8-wire step (+ its residual tree), and the fp32-wire
+    ZeRO Adam step — four traces for the whole module."""
+    import types
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam, FusedLAMB
+    from apex_tpu.parallel.distributed import allreduce_gradients
+
+    policy = amp.get_policy("O2")
+    params = {"w": jnp.ones((64, 64), jnp.bfloat16)}
+    grads = {"w": jnp.ones((64, 64), jnp.float32)}
+
+    def step(opt, reduce_first=False):
+        def fn(p, g):
+            st = opt.init(p)
+            if reduce_first:
+                g = allreduce_gradients(g, ("data",))
+            return opt.apply_gradients(st, p, g)[0]
+        return lint_ir.trace_ir(fn, params, grads, axes={"data": 8})
+
+    lamb_zero = amp.MixedPrecisionOptimizer(
+        FusedLAMB(lr=1e-2, norm_psum_axis="data"), policy,
+        zero_axis="data", gather_dtype="bf16", log_grad_norm=True)
+    replicated = amp.MixedPrecisionOptimizer(FusedLAMB(lr=1e-2), policy)
+    q8 = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis="data", reduce_dtype="int8")
+    fp32_adam = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis="data")
+    residual = q8.zero_abstract_state(
+        params, types.SimpleNamespace(shape={"data": 8})).residual
+    return {"zero": step(lamb_zero),
+            "replicated": step(replicated, reduce_first=True),
+            "q8": step(q8), "fp32_wire": step(fp32_adam),
+            "residual": residual}
 
 
 # ---------------------------------------------------------------------------
@@ -474,30 +616,15 @@ def test_sequence_parallel_hazard_passes_decomposed_and_scalar():
     assert hz["census"]["other"] == {"psum": 1}
 
 
-def test_sequence_parallel_hazard_on_gpt_models():
+def test_sequence_parallel_hazard_on_gpt_models(gpt_sp_forward_irs):
     """The model-level regression gate (ISSUE 4 evidence): a
     sequence-parallel GPT forward jaxpr carries ZERO activation psums on
     the TP axis (embedding + per-layer all decomposed), while the plain-TP
-    twin shows the all-reduces the mode removes."""
-    import jax
-
-    from apex_tpu.models import GPTConfig, GPTModel
-
-    tiny = dict(vocab_size=64, hidden_size=32, num_layers=2,
-                num_attention_heads=4, max_seq_len=16, hidden_dropout=0.0,
-                compute_dtype=jnp.float32, remat=False)
-    toks = jnp.zeros((2, 16), jnp.int32)
-    counts = {}
-    for sp in (False, True):
-        model = GPTModel(GPTConfig(axis="model", sequence_parallel=sp,
-                                   **tiny))
-        params = jax.tree.map(
-            lambda a: jnp.zeros(a.shape, a.dtype),
-            jax.eval_shape(model.init, jax.random.PRNGKey(0)))
-        hz = trace.sequence_parallel_hazards(
-            lambda p, t: model.apply(p, t, jnp.roll(t, -1, -1)),
-            params, toks, tp_axis="model", axes={"model": 2})
-        counts[sp] = hz
+    twin shows the all-reduces the mode removes. (Both forwards come
+    pre-traced from the module fixture — the analyzer reads the shared
+    walk.)"""
+    counts = {sp: trace.sequence_parallel_hazards(ir, tp_axis="model")
+              for sp, ir in gpt_sp_forward_irs.items()}
     assert counts[True]["activation_psums"] == 0
     assert not counts[True]["hazard"]
     # plain TP: embedding psum + the per-layer pair (scanned body counts
@@ -551,40 +678,16 @@ def test_zero_redundancy_passes_decomposed_and_scalar():
     assert hz["census"]["other"].get("psum") >= 1  # the norm + loss pmean
 
 
-def test_zero_redundancy_on_real_mixed_precision_step():
+def test_zero_redundancy_on_real_mixed_precision_step(zero_amp_step_irs):
     """The actual ZeRO amp step (MixedPrecisionOptimizer(zero_axis=...))
     traces clean; the replicated harness pattern (allreduce_gradients on
-    the data axis) is exactly the flagged regression."""
-    from apex_tpu import amp
-    from apex_tpu.optimizers import FusedLAMB
-    from apex_tpu.parallel.distributed import allreduce_gradients
-
-    policy = amp.get_policy("O2")
-    params = {"w": jnp.ones((64, 64), jnp.bfloat16)}
-    grads = {"w": jnp.ones((64, 64), jnp.float32)}
-
-    z = amp.MixedPrecisionOptimizer(
-        FusedLAMB(lr=1e-2, norm_psum_axis="data"), policy,
-        zero_axis="data", gather_dtype="bf16", log_grad_norm=True)
-
-    def zero_step(p, g):
-        st = z.init(p)
-        return z.apply_gradients(st, p, g)[0]
-
-    hz = trace.zero_redundancy_hazards(zero_step, params, grads,
-                                       axes={"data": 8})
+    the data axis) is exactly the flagged regression. (Both steps come
+    pre-traced from the module fixture.)"""
+    hz = trace.zero_redundancy_hazards(zero_amp_step_irs["zero"])
     assert not hz["hazard"], hz
     assert hz["census"]["bulk"].get("reduce_scatter") == 1
 
-    ref = amp.MixedPrecisionOptimizer(FusedLAMB(lr=1e-2), policy)
-
-    def replicated_step(p, g):
-        st = ref.init(p)
-        return ref.apply_gradients(
-            st, p, allreduce_gradients(g, ("data",)))[0]
-
-    hz = trace.zero_redundancy_hazards(replicated_step, params, grads,
-                                       axes={"data": 8})
+    hz = trace.zero_redundancy_hazards(zero_amp_step_irs["replicated"])
     assert hz["hazard"] and hz["bulk_psums"] >= 1
 
 
@@ -629,62 +732,22 @@ def test_zero3_gather_passes_per_layer_gathers():
     assert hz["min_model_elems"] == L * 512 // 4
 
 
-def test_zero3_gather_on_real_gpt_step():
+def test_zero3_gather_on_real_gpt_step(zero3_gpt_irs):
     """The real fully-sharded drive (zero3_shard + run_layers chunk_meta)
     traces clean through value_and_grad — every gather, forward AND the
     remat re-gathers in backward, is one layer's params — while
-    materializing the stacked leaves whole before the loss is flagged."""
-    import jax
-
-    from apex_tpu import amp
-    from apex_tpu.models import GPTConfig, GPTModel
-    from apex_tpu.optimizers import FusedAdam
-    from apex_tpu.optimizers.distributed import (
-        gather_chunked_tree,
-        gather_stacked_leaf,
-    )
-
-    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=8,
-                    num_attention_heads=2, max_seq_len=8,
-                    hidden_dropout=0.0, axis=None, unroll_layers=True)
-    model = GPTModel(cfg)
-    params = jax.tree.map(
-        lambda a: jnp.zeros(a.shape, a.dtype),
-        jax.eval_shape(model.init, jax.random.PRNGKey(0)))
-    mp_opt = amp.MixedPrecisionOptimizer(
-        FusedAdam(lr=1e-3), amp.get_policy("O2"),
-        zero_axis="data", zero_level=3)
-    meta = mp_opt.zero3_meta(params)
-    layer_meta = meta.subtree("layers")
-    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
-    toks = jnp.zeros((2, 8), jnp.int32)
+    materializing the stacked leaves whole before the loss is flagged.
+    (All drives come pre-traced from the module fixture: one trace each,
+    shared with the prefetch tripwire below.)"""
     # any single-layer row gather is <= ~1k elems; a stacked-leaf gather
-    # is L x that — 4096 splits them
-    thresh = dict(axes={"data": 8}, min_model_elems=4096)
-
-    def jit_gather_loss(p):
-        chunks = mp_opt.zero3_shard(p)
-        rest = gather_chunked_tree(
-            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
-        return model.loss(dict(rest, layers=chunks["layers"]), toks, toks,
-                          layer_chunk_meta=layer_meta)
-
-    hz = trace.zero3_gather_hazards(
-        jax.value_and_grad(jit_gather_loss), params, **thresh)
+    # is L x that — 4096 splits them at the fixture's (h=16, L=4) shape
+    hz = trace.zero3_gather_hazards(zero3_gpt_irs["serialized"],
+                                    min_model_elems=4096)
     assert not hz["hazard"], hz
-    assert hz["layer_gathers"] >= cfg.num_layers  # unrolled: per layer
+    assert hz["layer_gathers"] >= zero3_gpt_irs["num_layers"]  # unrolled
 
-    def bulk_gather_loss(p):
-        chunks = mp_opt.zero3_shard(p)
-        layers = jax.tree.map(
-            lambda c, s: gather_stacked_leaf(c, s.shape, s.dtype, "data"),
-            chunks["layers"], layer_meta.shapes,
-            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-        rest = gather_chunked_tree(
-            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
-        return model.loss(dict(rest, layers=layers), toks, toks)
-
-    hz = trace.zero3_gather_hazards(bulk_gather_loss, params, **thresh)
+    hz = trace.zero3_gather_hazards(zero3_gpt_irs["bulk"],
+                                    min_model_elems=4096)
     assert hz["hazard"] and hz["bulk_gathers"] >= 1, hz
 
 
@@ -730,55 +793,21 @@ def test_unprefetched_gather_flags_remat_fused_gathers():
     assert not ok["hazard"] and ok["free_gathers"] >= 4, ok
 
 
-def test_unprefetched_gather_on_real_zero3_step():
+def test_unprefetched_gather_on_real_zero3_step(zero3_gpt_irs):
     """Both ways on the REAL drives: the serialized unrolled chunk_meta
     step (zero3_prefetch=0) flags; the double-buffered drive
     (zero3_prefetch=1, models/_transformer._prefetched_zero3_drive)
     traces clean with its gathers free — and still passes the bulk-gather
-    tripwire (per-layer gathers only)."""
-    import jax
-
-    from apex_tpu import amp
-    from apex_tpu.models import GPTConfig, GPTModel
-    from apex_tpu.optimizers import FusedAdam
-    from apex_tpu.optimizers.distributed import gather_chunked_tree
-
-    base = dict(vocab_size=64, hidden_size=16, num_layers=4,
-                num_attention_heads=2, max_seq_len=8, hidden_dropout=0.0,
-                axis=None, unroll_layers=True)
-    params = jax.tree.map(
-        lambda a: jnp.zeros(a.shape, a.dtype),
-        jax.eval_shape(GPTModel(GPTConfig(**base)).init,
-                       jax.random.PRNGKey(0)))
-    mp_opt = amp.MixedPrecisionOptimizer(
-        FusedAdam(lr=1e-3), amp.get_policy("O2"),
-        zero_axis="data", zero_level=3)
-    meta = mp_opt.zero3_meta(params)
-    layer_meta = meta.subtree("layers")
-    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
-    toks = jnp.zeros((2, 8), jnp.int32)
-
-    def loss_fn(prefetch):
-        model = GPTModel(GPTConfig(zero3_prefetch=prefetch, **base))
-
-        def fn(p):
-            chunks = mp_opt.zero3_shard(p)
-            rest = gather_chunked_tree(
-                {k: v for k, v in chunks.items() if k != "layers"},
-                rest_meta)
-            return model.loss(dict(rest, layers=chunks["layers"]),
-                              toks, toks, layer_chunk_meta=layer_meta)
-        return fn
-
-    bad = trace.unprefetched_gather_hazards(
-        jax.value_and_grad(loss_fn(0)), params, axes={"data": 8})
+    tripwire (per-layer gathers only). The SAME StepIRs the bulk-gather
+    test reads: one trace, N analyzers (the single-trace-walker
+    contract)."""
+    bad = trace.unprefetched_gather_hazards(zero3_gpt_irs["serialized"])
     assert bad["hazard"] and bad["fused_gathers"] >= 2, bad
-    jx = jax.make_jaxpr(jax.value_and_grad(loss_fn(1)),
-                        axis_env=[("data", 8)])(params)
-    ok = trace.unprefetched_gather_hazards(jx)
+    ok = trace.unprefetched_gather_hazards(zero3_gpt_irs["prefetched"])
     assert not ok["hazard"] and ok["free_gathers"] >= 4, ok
     # the prefetched drive must not regress the bulk-gather tripwire
-    bulk = trace.zero3_gather_hazards(jx, min_model_elems=4096)
+    bulk = trace.zero3_gather_hazards(zero3_gpt_irs["prefetched"],
+                                      min_model_elems=4096)
     assert not bulk["hazard"], bulk
 
 
@@ -835,42 +864,18 @@ def test_quantized_comm_passes_encoded_pair_and_checks_residual():
         good, big, axes={"data": 8})["hazard"]
 
 
-def test_quantized_comm_on_real_mixed_precision_step():
+def test_quantized_comm_on_real_mixed_precision_step(zero_amp_step_irs):
     """The actual reduce_dtype='int8' amp step traces clean with its
     residual state; the SAME step read at reduce_dtype=None is the
-    flagged fat-wire pattern — the tripwire pair the selftest runs."""
-    from apex_tpu import amp
-    from apex_tpu.optimizers import FusedAdam
-
-    policy = amp.get_policy("O2")
-    params = {"w": jnp.ones((64, 64), jnp.bfloat16)}
-    grads = {"w": jnp.ones((64, 64), jnp.float32)}
-
-    q = amp.MixedPrecisionOptimizer(
-        FusedAdam(lr=1e-2), policy, zero_axis="data", reduce_dtype="int8")
-
-    def q_step(p, g):
-        st = q.init(p)
-        return q.apply_gradients(st, p, g)[0]
-
-    import types
-
-    residual = q.zero_abstract_state(
-        params, types.SimpleNamespace(shape={"data": 8})).residual
-    hz = trace.quantized_comm_hazards(q_step, params, grads,
-                                      axes={"data": 8}, residual=residual)
+    flagged fat-wire pattern — the tripwire pair the selftest runs.
+    (Pre-traced by the module fixture, shared with the redundancy
+    test.)"""
+    hz = trace.quantized_comm_hazards(
+        zero_amp_step_irs["q8"], residual=zero_amp_step_irs["residual"])
     assert not hz["hazard"], hz
     assert hz["quantized_reduces"] >= 1
 
-    z = amp.MixedPrecisionOptimizer(
-        FusedAdam(lr=1e-2), policy, zero_axis="data")
-
-    def fp32_step(p, g):
-        st = z.init(p)
-        return z.apply_gradients(st, p, g)[0]
-
-    hz = trace.quantized_comm_hazards(fp32_step, params, grads,
-                                      axes={"data": 8})
+    hz = trace.quantized_comm_hazards(zero_amp_step_irs["fp32_wire"])
     assert hz["hazard"] and hz["fat_reduces"] >= 1
 
 
