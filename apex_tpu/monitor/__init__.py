@@ -43,6 +43,23 @@ extracts those patterns into a reusable subsystem any training loop
   throughput percentiles, stall gaps, loss spikes, HBM-growth trend,
   per-rank straggler skew, comm rollups; ``... report compare A B``
   exits non-zero on regression (the bench-trajectory machine gate).
+- :mod:`flight` — :class:`FlightRecorder` (ISSUE 14): a bounded
+  in-memory ring of recent journal/span records + breadcrumbs, dumped as
+  one strict-JSON crash file (``<journal>.flight.json``) on unhandled
+  exception, SIGTERM, or watchdog kill — with an HBM snapshot and the
+  last loss-scale state; breadcrumbs at the ``comm:`` scopes and
+  device→host fetch points feed the structured heartbeat, so a watchdog
+  kill report names the operation the child was stuck in.
+- :mod:`health` — :class:`HealthMonitor` (ISSUE 14): streaming
+  per-record detectors (loss spike, grad-norm drift, tok/s collapse,
+  HBM growth, overflow rate, serve queue/SLO burn) evaluated as records
+  are written, emitting ``kind="alert"`` rows; ``health.scan`` replays
+  them offline for ``report``'s alerts section and the
+  ``report compare --max-alerts`` gate.
+- :mod:`status` — ``python -m apex_tpu.monitor.status <run.jsonl>``:
+  live one-screen tail of a running journal (+ heartbeat/flight files):
+  step rate, loss, HBM, bubble/overlap, serve queue + SLO, the last
+  breadcrumb, and the alert feed; ``--once --format json`` for machines.
 - :mod:`selftest` — ``python -m apex_tpu.monitor.selftest``: fast off-TPU
   smoke of all pieces, wired into ``__graft_entry__.dryrun_multichip``.
 
@@ -91,4 +108,11 @@ from apex_tpu.monitor.watchdog import (  # noqa: F401
     Heartbeat,
     WatchdogResult,
     run_under_watchdog,
+)
+from apex_tpu.monitor.flight import (  # noqa: F401
+    FlightRecorder,
+    breadcrumb,
+)
+from apex_tpu.monitor.health import (  # noqa: F401
+    HealthMonitor,
 )

@@ -148,4 +148,19 @@ def collective_scope(verb: str, axis: AxisNames, tree: Any):
         nbytes, dtype = _tree_bytes(tree)
         for acct in _ACTIVE:
             acct.add(verb, label, nbytes, dtype)
+    try:
+        # hang-attribution breadcrumb (monitor/flight.py): stamp the
+        # scope being ENTERED so a process wedged inside it dies with
+        # its name in the structured heartbeat (watchdog kill report).
+        # This call site runs at TRACE time (and in the eager per-tick
+        # drives), so it attributes compile-/trace-time and eager-drive
+        # hangs; a COMPILED step wedged on-device is attributed by the
+        # fetch-point breadcrumbs instead. A dict assignment when no
+        # flight/heartbeat consumer is armed; the compiled program is
+        # untouched either way.
+        from apex_tpu.monitor import flight as _flight
+
+        _flight.breadcrumb(f"comm:{verb}[{label}]")
+    except Exception:  # noqa: BLE001 - telemetry must not kill tracing
+        pass
     return jax.named_scope(f"comm:{verb}[{label}]")

@@ -1,0 +1,282 @@
+"""Online health rules: streaming per-record detectors → ``alert`` rows.
+
+``monitor.report`` judges a run after it ends; this module judges it
+WHILE it runs. A :class:`HealthMonitor` consumes journal records as they
+are written (wire one into ``MetricsJournal(health=...)`` — ``log``
+feeds every record through and appends the resulting ``kind="alert"``
+rows to the same journal) and fires bounded, de-stormed alerts:
+
+- ``loss-spike``        — |loss| beyond ``spike_factor`` × the trailing
+  median (THE shared predicate, ``diagnose.is_loss_spike`` — online,
+  offline report, and forensics can never desynchronize);
+- ``grad-norm-drift``   — grad norm beyond ``drift_factor`` × its
+  trailing median (the pre-divergence tell);
+- ``throughput-collapse`` — tokens/s below ``collapse_frac`` × the
+  trailing median (co-tenant pressure / silent recompile churn);
+- ``hbm-growth``        — live-array bytes more than ``hbm_slack_bytes``
+  above the first sample (the below-Python-leak curve, re-armed one
+  slack past each firing so a creeping leak keeps alerting);
+- ``overflow-rate``     — cumulative overflow skips above
+  ``overflow_rate_max`` of steps (latched once);
+- ``queue-depth``       — serve queue depth above ``queue_limit`` for
+  ``queue_consecutive`` ticks (off until a limit is configured);
+- ``slo-burn``          — a serve SLO window record (``kind="slo"``,
+  emitted by ``serve.Engine`` when targets are set) whose attainment
+  fell below its own stamped target.
+
+:func:`scan` replays the same rules over a stored journal — the offline
+twin ``report.analyze`` uses for its alerts section and ``report compare
+--max-alerts`` gates on, so the gate works on journals that never armed
+a monitor. Pure host-side stdlib: compiled step/serve programs are
+untouched (the byte-identity discipline of ``--trace``).
+
+No reference-file citation: NVIDIA Apex has no telemetry layer; the
+SLO-burn framing follows production serving practice (veScale's
+operational-visibility thesis, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from apex_tpu.monitor.diagnose import is_loss_spike, median
+
+#: every rule this module can fire (docs + report rollup keys)
+RULES = ("loss-spike", "grad-norm-drift", "throughput-collapse",
+         "hbm-growth", "overflow-rate", "queue-depth", "slo-burn")
+
+_DEFAULTS = dict(
+    spike_factor=3.0, spike_window=16,
+    drift_factor=10.0, drift_window=16, drift_min_history=8,
+    collapse_frac=0.5, collapse_window=16, collapse_min_history=8,
+    hbm_slack_bytes=256 << 20,
+    overflow_rate_max=0.1, overflow_min_steps=20,
+    queue_limit=None, queue_consecutive=8,
+    slo_attainment_min=None,   # None: honor each slo record's own target
+    cooldown=8,                # records suppressed per rule after a fire
+)
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+class HealthMonitor:
+    """Streaming rule evaluator. Feed records via :meth:`observe`; it
+    returns the ``kind="alert"`` rows this record triggered (usually
+    empty). Holds all trailing-window state; one instance per run.
+
+    >>> journal = MetricsJournal(path, health=HealthMonitor())
+    >>> ...  # step_end/log as usual; alerts land in the journal
+    >>> journal.health.alerts     # everything fired so far
+    """
+
+    def __init__(self, **cfg):
+        unknown = set(cfg) - set(_DEFAULTS)
+        if unknown:
+            raise TypeError(f"unknown health config keys: {sorted(unknown)}")
+        self.cfg = dict(_DEFAULTS, **cfg)
+        self.alerts: List[Dict[str, Any]] = []
+        c = self.cfg
+        self._losses: deque = deque(maxlen=int(c["spike_window"]))
+        self._grads: deque = deque(maxlen=int(c["drift_window"]))
+        self._rates: deque = deque(maxlen=int(c["collapse_window"]))
+        self._hbm_first: Optional[float] = None
+        self._hbm_next_fire: Optional[float] = None
+        self._overflow_latched = False
+        self._queue_over = 0
+        self._steps = 0
+        self._since_fire: Dict[str, int] = {}
+
+    # -- de-storming --------------------------------------------------------
+    def _fire(self, rule: str, *, step=None, value=None, baseline=None,
+              message: str = "") -> Optional[Dict[str, Any]]:
+        """Emit one alert unless the rule is inside its cooldown window
+        (a sustained condition must page once per window, not once per
+        record)."""
+        if self._since_fire.get(rule, 1 << 30) < int(self.cfg["cooldown"]):
+            return None
+        self._since_fire[rule] = 0
+        alert: Dict[str, Any] = {"kind": "alert", "rule": rule,
+                                 "message": message}
+        if step is not None:
+            alert["step"] = step
+        if value is not None:
+            alert["value"] = round(float(value), 6)
+        if baseline is not None:
+            alert["baseline"] = round(float(baseline), 6)
+        self.alerts.append(alert)
+        return alert
+
+    # -- the streaming entry point ------------------------------------------
+    def observe(self, rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Evaluate one journal record; returns the alerts it fired."""
+        if not isinstance(rec, dict) or rec.get("kind") == "alert":
+            return []
+        for rule in self._since_fire:
+            self._since_fire[rule] += 1
+        out: List[Dict[str, Any]] = []
+        kind = rec.get("kind", "step")
+        if kind == "step":
+            out.extend(self._observe_step(rec))
+        if kind == "hbm" or isinstance(rec.get("hbm"), dict):
+            out.extend(self._observe_hbm(rec))
+        if kind == "slo":
+            out.extend(self._observe_slo(rec))
+        return out
+
+    # -- training-shaped rules ----------------------------------------------
+    def _observe_step(self, rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        c = self.cfg
+        out: List[Dict[str, Any]] = []
+        step = rec.get("step", rec.get("window"))
+        self._steps += 1
+
+        # loss spike — overflow steps never enter the baseline or the
+        # spike check, and sanitized-NaN losses are the forensics
+        # layer's business (matching report.analyze exactly)
+        loss = _num(rec.get("loss"))
+        keys = rec.get("nonfinite_keys") or []
+        loss_is_nan = any(k == "loss" or k.endswith(".loss") for k in keys)
+        if loss is not None and not rec.get("found_inf") and not loss_is_nan:
+            base = (median(self._losses)
+                    if len(self._losses) >= 4 else None)
+            if is_loss_spike(loss, base, c["spike_factor"]):
+                a = self._fire("loss-spike", step=step, value=loss,
+                               baseline=base,
+                               message=f"loss {loss:.4g} > "
+                                       f"{c['spike_factor']:g}x trailing "
+                                       f"median {base:.4g}")
+                if a:
+                    out.append(a)
+            self._losses.append(loss)
+
+        # grad-norm drift
+        gn = _num(rec.get("grad_norm"))
+        if gn is not None and not rec.get("found_inf"):
+            base = (median(self._grads)
+                    if len(self._grads) >= int(c["drift_min_history"])
+                    else None)
+            if base is not None and gn > c["drift_factor"] * max(base, 1e-12):
+                a = self._fire("grad-norm-drift", step=step, value=gn,
+                               baseline=base,
+                               message=f"grad norm {gn:.4g} > "
+                                       f"{c['drift_factor']:g}x trailing "
+                                       f"median {base:.4g}")
+                if a:
+                    out.append(a)
+            self._grads.append(gn)
+
+        # throughput collapse
+        rate = _num(rec.get("tokens_per_sec"))
+        if rate is not None:
+            base = (median(self._rates)
+                    if len(self._rates) >= int(c["collapse_min_history"])
+                    else None)
+            if base is not None and rate < c["collapse_frac"] * base:
+                a = self._fire("throughput-collapse", step=step, value=rate,
+                               baseline=base,
+                               message=f"tokens/s {rate:.4g} < "
+                                       f"{c['collapse_frac']:g}x trailing "
+                                       f"median {base:.4g}")
+                if a:
+                    out.append(a)
+            self._rates.append(rate)
+
+        # overflow rate (cumulative counter rides every step record)
+        ov = _num(rec.get("overflows"))
+        if (ov is not None and not self._overflow_latched
+                and self._steps >= int(c["overflow_min_steps"])):
+            rate_ov = ov / self._steps
+            if rate_ov > c["overflow_rate_max"]:
+                self._overflow_latched = True
+                a = self._fire("overflow-rate", step=step, value=rate_ov,
+                               baseline=c["overflow_rate_max"],
+                               message=f"overflow rate {rate_ov:.3f} over "
+                                       f"{self._steps} steps exceeds "
+                                       f"{c['overflow_rate_max']:g}")
+                if a:
+                    out.append(a)
+
+        # serve queue depth (only when a limit is configured)
+        qd = _num(rec.get("queue_depth"))
+        if qd is not None and c["queue_limit"] is not None:
+            if qd > c["queue_limit"]:
+                self._queue_over += 1
+                if self._queue_over >= int(c["queue_consecutive"]):
+                    a = self._fire("queue-depth", step=step, value=qd,
+                                   baseline=c["queue_limit"],
+                                   message=f"queue depth {qd:g} above "
+                                           f"{c['queue_limit']:g} for "
+                                           f"{self._queue_over} tick(s)")
+                    if a:
+                        out.append(a)
+            else:
+                self._queue_over = 0
+        return out
+
+    def _observe_hbm(self, rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        c = self.cfg
+        sub = rec.get("hbm") if isinstance(rec.get("hbm"), dict) else rec
+        live = _num(sub.get("live_bytes"))
+        if live is None:
+            return []
+        if self._hbm_first is None:
+            self._hbm_first = live
+            self._hbm_next_fire = live + float(c["hbm_slack_bytes"])
+            return []
+        if live > self._hbm_next_fire:
+            # re-arm one slack past this firing: a creeping leak keeps
+            # alerting instead of latching silent after the first page
+            self._hbm_next_fire = live + float(c["hbm_slack_bytes"])
+            a = self._fire(
+                "hbm-growth", step=rec.get("step"), value=live,
+                baseline=self._hbm_first,
+                message=f"live bytes grew "
+                        f"{(live - self._hbm_first) / 1e6:.1f} MB past the "
+                        f"{c['hbm_slack_bytes'] / 1e6:.0f} MB slack")
+            return [a] if a else []
+        return []
+
+    def _observe_slo(self, rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        c = self.cfg
+        att = _num(rec.get("attainment"))
+        target = (c["slo_attainment_min"]
+                  if c["slo_attainment_min"] is not None
+                  else _num(rec.get("target")))
+        if att is None or target is None or att >= target:
+            return []
+        a = self._fire("slo-burn", step=rec.get("window"), value=att,
+                       baseline=target,
+                       message=f"SLO attainment {att:.3f} below target "
+                               f"{target:.3f} this window")
+        return [a] if a else []
+
+    def summary(self) -> Dict[str, Any]:
+        return summarize(self.alerts)
+
+
+def summarize(alerts: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """``{"count", "by_rule"}`` rollup of an alert list — THE one copy
+    shared by :meth:`HealthMonitor.summary`, ``report.analyze``'s alerts
+    section, and the gpt_scaling per-config stamp."""
+    by_rule: Dict[str, int] = {}
+    for a in alerts:
+        by_rule[a["rule"]] = by_rule.get(a["rule"], 0) + 1
+    return {"count": len(alerts), "by_rule": by_rule}
+
+
+def scan(records: Sequence[Dict[str, Any]], **cfg) -> List[Dict[str, Any]]:
+    """Replay the streaming rules over a stored journal — the offline
+    twin of a wired :class:`HealthMonitor` (same rule objects, so online
+    and offline verdicts can never drift). Journaled ``kind="alert"``
+    rows are skipped on input (no feedback)."""
+    mon = HealthMonitor(**cfg)
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        out.extend(mon.observe(rec))
+    return out
+
+
+__all__ = ["HealthMonitor", "scan", "summarize", "RULES"]

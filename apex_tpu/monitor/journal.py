@@ -121,7 +121,14 @@ class MetricsJournal:
         meta: Optional[Dict[str, Any]] = None,
         sample_hbm_every: int = 0,
         flush_every: int = 1,
+        health=None,
     ):
+        # online health rules (monitor/health.py): every record written
+        # streams through the monitor's detectors and the resulting
+        # kind="alert" rows append to this same journal (log() below) —
+        # the "evaluated as records are written" wiring; None costs one
+        # attribute check per log
+        self.health = health
         if hasattr(path_or_file, "write"):
             self._f, self._own = path_or_file, False
             self.path = getattr(path_or_file, "name", None)
@@ -260,7 +267,27 @@ class MetricsJournal:
                 self._since_flush = 0
         except Exception:  # noqa: BLE001 - telemetry must not kill training
             pass
+        try:
+            # black-box feed (monitor/flight.py): an armed flight
+            # recorder keeps the last records for the crash dump; a
+            # single module-global check when disarmed
+            from apex_tpu.monitor import flight as _flight
+
+            _flight.observe_record(rec)
+        except Exception:  # noqa: BLE001 - telemetry must not kill training
+            pass
+        if self.health is not None and rec.get("kind") != "alert":
+            try:
+                for alert in self.health.observe(rec):
+                    self.log(alert)  # one level deep: alerts skip observe
+            except Exception:  # noqa: BLE001 - telemetry must not kill work
+                pass
         return rec
+
+    def set_health(self, monitor) -> None:
+        """Attach (or replace) the online health monitor after
+        construction — harness paths that build the journal first."""
+        self.health = monitor
 
     # -- the step protocol --------------------------------------------------
     def step_start(self) -> float:
@@ -287,6 +314,15 @@ class MetricsJournal:
         """
         loss_val = None
         if loss is not None:
+            try:
+                # hang-attribution breadcrumb (monitor/flight.py): this
+                # fetch is where a wedged tunnel actually hangs — stamp
+                # it BEFORE blocking so the watchdog kill report names it
+                from apex_tpu.monitor import flight as _flight
+
+                _flight.breadcrumb(f"fetch:loss[step={step}]")
+            except Exception:  # noqa: BLE001 - telemetry must not raise
+                pass
             loss_val = float(loss)  # device→host fetch stops the clock
         if wall_s is None:
             wall_s = (time.perf_counter() - self._t0

@@ -364,6 +364,46 @@ def analyze(
             sv["accepted_len"] = _dist(acc)
         out["serving"] = sv
 
+    # serve SLO windows (kind="slo" records from serve.Engine when
+    # ServeConfig targets are set): per-window attainment — the fraction
+    # of tokens inside their TTFT/ITL targets — plus goodput (in-SLO
+    # tokens/s). Lives beside "serving" even for journals with slo rows
+    # but no request records (crash-truncated runs).
+    slo_rows = [r for r in records if r.get("kind") == "slo"]
+    if slo_rows:
+        att = [r["attainment"] for r in slo_rows
+               if isinstance(r.get("attainment"), (int, float))]
+        gp = [r["goodput_tokens_per_sec"] for r in slo_rows
+              if isinstance(r.get("goodput_tokens_per_sec"), (int, float))]
+        slo: Dict[str, Any] = {"windows": len(slo_rows)}
+        if att:
+            slo["attainment"] = _dist(att)
+        if gp:
+            slo["goodput_tokens_per_sec"] = _dist(gp)
+        tgt = next((r.get("target") for r in slo_rows
+                    if isinstance(r.get("target"), (int, float))), None)
+        if tgt is not None:
+            slo["target"] = tgt
+        out["slo"] = slo
+
+    # health alerts (monitor/health.py): the DERIVED count replays the
+    # streaming rules over this journal (so the --max-alerts gate works
+    # on journals that never armed a monitor); "journaled" counts the
+    # kind="alert" rows an armed monitor wrote live. Always present, so
+    # compare's alert check never skips on a clean run.
+    try:
+        from apex_tpu.monitor import health as health_mod
+
+        derived = health_mod.scan(records)
+        rollup = health_mod.summarize(derived)
+    except Exception:  # noqa: BLE001 - analysis must survive a bad journal
+        derived, rollup = [], {"count": 0, "by_rule": {}}
+    out["alerts"] = dict(
+        rollup,
+        journaled=sum(1 for r in records if r.get("kind") == "alert"),
+        list=derived[:max_list],
+    )
+
     # overflow / forensics / recompile rollups
     overflows = [r["overflows"] for r in steps
                  if isinstance(r.get("overflows"), (int, float))]
@@ -501,6 +541,24 @@ def render(analysis: Dict[str, Any], file=None) -> None:
             parts.append(f"accepted draft len p50 "
                          f"{sv['accepted_len']['p50']}")
         p("serving: " + "; ".join(parts))
+    slo = analysis.get("slo")
+    if slo:
+        att = slo.get("attainment") or {}
+        gp = slo.get("goodput_tokens_per_sec") or {}
+        p(f"slo: {slo['windows']} window(s), attainment p50 "
+          f"{att.get('p50')} (min {att.get('min')}"
+          + (f", target {slo['target']}" if slo.get("target") is not None
+             else "")
+          + (f"), goodput p50 {gp.get('p50')} tok/s" if gp else ")"))
+    al = analysis.get("alerts")
+    if al:
+        rules = ", ".join(f"{k}: {v}"
+                          for k, v in sorted(al["by_rule"].items()))
+        live = (f"; {al['journaled']} journaled live"
+                if al.get("journaled") else "")
+        p(f"alerts: {al['count']} ({rules or 'none'}{live})")
+        for a in al.get("list", [])[:8]:
+            p(f"  [{a['rule']}] step {a.get('step')}: {a.get('message')}")
     p(f"overflows: {analysis.get('overflows', 0)}")
     fo = analysis.get("forensics")
     if fo:
@@ -542,6 +600,7 @@ def compare(
     loss_threshold: Optional[float] = None,
     bubble_threshold: Optional[float] = None,
     overlap_threshold: Optional[float] = None,
+    max_alerts: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Compare run B against baseline A; ``regressed`` iff B is worse.
 
@@ -583,6 +642,12 @@ def compare(
     hit-rate / mean accepted draft length (``kind="prefill"`` and step
     ``accepted_len`` stamps) must not DROP — the same
     :func:`must_not_drop` predicate throughput uses.
+
+    ``max_alerts`` (off by default) arms the health-alert gate: the
+    candidate's derived alert count (``monitor/health.py`` rules replayed
+    over the journal by ``analyze``) may not exceed the budget nor the
+    baseline's own count — so a self-compare always passes and a noisy
+    baseline never fails its identical twin.
 
     ``bubble_threshold`` tunes the pipeline bubble-fraction gate
     independently of ``threshold`` (it defaults to ``threshold`` when
@@ -728,6 +793,23 @@ def compare(
           (sva.get("accepted_len") or {}).get("p50"),
           (svb.get("accepted_len") or {}).get("p50"),
           worse=must_not_drop(threshold))
+    # serve SLO attainment (kind="slo" window records): the fraction of
+    # tokens inside their latency targets must not DROP — the serving
+    # health twin of the throughput gate
+    check("slo_attainment_p50",
+          ((ra.get("slo") or {}).get("attainment") or {}).get("p50"),
+          ((rb.get("slo") or {}).get("attainment") or {}).get("p50"),
+          worse=must_not_drop(threshold))
+    if max_alerts is not None:
+        # health-alert gate (--max-alerts): the candidate's DERIVED alert
+        # count (health.scan — works on journals that never armed a live
+        # monitor) may not exceed the budget nor the baseline's own count
+        # (a noisy baseline doesn't fail its twin; self-compare always
+        # passes)
+        check("alerts",
+              (ra.get("alerts") or {}).get("count", 0),
+              (rb.get("alerts") or {}).get("count", 0),
+              worse=lambda va, vb: vb > max(va, max_alerts))
     regressed = [c["check"] for c in checks if c["regressed"]]
     return {"threshold": threshold, "checks": checks,
             "regressed": regressed, "ok": not regressed,
@@ -767,8 +849,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                             "overlap fraction (defaults to --threshold "
                             "when journals carry overlap_fraction stamps "
                             "— the structural-prefetch gate)")
+        p.add_argument("--max-alerts", type=int, default=None,
+                       help="arm the health-alert gate: the candidate's "
+                            "derived alert count (monitor/health.py rules "
+                            "replayed over the journal) may not exceed "
+                            "this budget nor the baseline's own count")
         p.add_argument("--json", action="store_true",
                        help="print the full comparison as one JSON object")
+        p.add_argument("--format", choices=("text", "json"), default=None,
+                       help="output format (json == --json; parity with "
+                            "`python -m apex_tpu.lint --format json`)")
         args = p.parse_args(argv[1:])
         res = compare(load(args.baseline), load(args.candidate),
                       threshold=args.threshold,
@@ -776,8 +866,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       hbm_slack_bytes=int(args.hbm_slack_mb * (1 << 20)),
                       loss_threshold=args.loss_threshold,
                       bubble_threshold=args.bubble_threshold,
-                      overlap_threshold=args.overlap_threshold)
-        if args.json:
+                      overlap_threshold=args.overlap_threshold,
+                      max_alerts=args.max_alerts)
+        if args.json or args.format == "json":
             print(json.dumps(res))
         else:
             for c in res["checks"]:
@@ -795,12 +886,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("journal")
     p.add_argument("--json", action="store_true",
                    help="print the analysis as one JSON object")
+    p.add_argument("--format", choices=("text", "json"), default=None,
+                   help="output format: json emits the full rollup as one "
+                        "JSON object (same as --json; parity with "
+                        "`python -m apex_tpu.lint --format json`, so "
+                        "CI/driver consumers stop scraping text)")
     p.add_argument("--stall-factor", type=float, default=5.0)
     p.add_argument("--spike-factor", type=float, default=3.0)
     args = p.parse_args(argv)
     analysis = analyze(load(args.journal), stall_factor=args.stall_factor,
                        spike_factor=args.spike_factor)
-    if args.json:
+    if args.json or args.format == "json":
         print(json.dumps(analysis))
     else:
         render(analysis)

@@ -7,8 +7,18 @@ that the monitor pieces stay importable and functional:
    schema fields (wall time, tokens/s, loss, loss-scale state, grad norm,
    overflow counter, rank info, HBM sample); non-finite values sanitize
    to strict JSON; a truncated final line still parses;
+1b. flight (ISSUE 14): journal records + breadcrumbs ring in the armed
+   flight recorder and an explicit dump round-trips as strict JSON with
+   the HBM snapshot and loss-scale state; a corrupt dump loads as None;
+
+1c. health (ISSUE 14): the online rule monitor fires exactly the
+   loss-spike rule on a seeded spike (journal wiring and the offline
+   ``health.scan`` agree), a clean journal fires none, and a seeded SLO
+   window under its target fires slo-burn;
+
 2. watchdog: a healthy child passes through; a deliberately-hung child is
-   killed at the deadline and its last checkpoint is recovered;
+   killed at the deadline and its last checkpoint is recovered (the kill
+   report carrying the structured heartbeat's stage attribution);
 3. hbm: a toy loop that retains arrays shows monotone visible growth, a
    non-retaining loop stays flat;
 4. comms: traced collectives land in a :class:`CommAccount` keyed by axis;
@@ -107,6 +117,102 @@ def _check_journal() -> dict:
         return {"ok": True, "records": len(rows)}
     finally:
         os.unlink(path)
+
+
+def _check_flight() -> dict:
+    """ISSUE 14: flight-recorder ring dump round-trip — journal records
+    and breadcrumbs ring in memory, an explicit dump lands as strict
+    JSON with the HBM snapshot + loss-scale state, tolerant load
+    degrades a corrupt file to None, and disarm leaves no global."""
+    import jax.numpy as jnp
+
+    from apex_tpu.monitor import flight
+    from apex_tpu.monitor.journal import MetricsJournal
+
+    d = tempfile.mkdtemp(prefix="apex_tpu_flight_")
+    try:
+        jpath = os.path.join(d, "run.jsonl")
+        fpath = jpath + ".flight.json"
+        fr = flight.arm(fpath, meta={"run": "selftest"}, capacity=64,
+                        hooks=False)
+        with MetricsJournal(jpath) as j:
+            for step in range(3):
+                j.step_start()
+                j.step_end(step=step,
+                           loss=jnp.asarray(2.0 - 0.1 * step, jnp.float32),
+                           tokens=1024,
+                           metrics={"loss_scale": 2.0 ** 16,
+                                    "found_inf": False})
+        flight.breadcrumb("comm:psum[data]")
+        path = fr.dump("explicit")
+        assert path == fpath, path
+        import json as _json
+
+        with open(fpath) as f:
+            dump = _json.loads(f.read())  # strict JSON by construction
+        steps = [r for r in dump["ring"] if r.get("kind") == "step"]
+        assert len(steps) == 3 and steps[-1]["step"] == 2, dump["ring"]
+        assert dump["last_op"]["op"] == "comm:psum[data]", dump["last_op"]
+        assert dump["scaler"]["loss_scale"] == 2.0 ** 16, dump.get("scaler")
+        assert isinstance(dump["hbm"], dict), dump.get("hbm")
+        assert flight.load(fpath) is not None
+        # corrupt dumps degrade to None, never raise
+        with open(fpath, "w") as f:
+            f.write('{"v": 1, "ring": [tor')
+        assert flight.load(fpath) is None
+        return {"ok": True, "ring": len(dump["ring"]),
+                "last_op": dump["last_op"]["op"]}
+    finally:
+        flight.disarm()
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _check_health() -> dict:
+    """ISSUE 14: online health rules — a seeded loss spike fires exactly
+    the loss-spike rule (online journal wiring AND the offline scan
+    agree), a clean journal fires none, and a seeded SLO-burn window
+    fires slo-burn."""
+    from apex_tpu.monitor import health
+
+    def run(spike: bool):
+        recs = [{"kind": "step", "step": s, "loss": 2.0 - 0.01 * s,
+                 "tokens_per_sec": 1000.0, "overflows": 0}
+                for s in range(12)]
+        if spike:
+            recs[10]["loss"] = 50.0
+        return health.scan(recs)
+
+    assert run(False) == [], run(False)
+    fired = run(True)
+    assert [a["rule"] for a in fired] == ["loss-spike"], fired
+    assert fired[0]["step"] == 10, fired
+
+    # online wiring: the journal streams records through the monitor and
+    # appends the alert rows itself
+    from apex_tpu.monitor.journal import MetricsJournal
+
+    fd, path = tempfile.mkstemp(prefix="apex_tpu_health_", suffix=".jsonl")
+    os.close(fd)
+    try:
+        with MetricsJournal(path, health=health.HealthMonitor()) as j:
+            for s in range(12):
+                j.log({"kind": "step", "step": s,
+                       "loss": 50.0 if s == 10 else 2.0,
+                       "tokens_per_sec": 1000.0, "overflows": 0})
+        rows = MetricsJournal.read(path)
+        alerts = [r for r in rows if r["kind"] == "alert"]
+        assert len(alerts) == 1 and alerts[0]["rule"] == "loss-spike", alerts
+    finally:
+        os.unlink(path)
+
+    # slo-burn honors the window record's own stamped target
+    burn = health.scan([{"kind": "slo", "window": 0, "attainment": 0.5,
+                         "target": 0.99}])
+    assert [a["rule"] for a in burn] == ["slo-burn"], burn
+    return {"ok": True, "spike_rule": fired[0]["rule"],
+            "rules": list(health.RULES)}
 
 
 def _check_watchdog() -> dict:
@@ -728,6 +834,8 @@ def run() -> dict:
     """In-process smoke (no platform mutation — safe under any backend)."""
     results = {}
     for name, fn in (("journal", _check_journal),
+                     ("flight", _check_flight),
+                     ("health", _check_health),
                      ("watchdog", _check_watchdog),
                      ("hbm", _check_hbm),
                      ("comms", _check_comms),

@@ -1,0 +1,266 @@
+"""Live run view: ``python -m apex_tpu.monitor.status <run.jsonl>``.
+
+The report CLI judges a FINISHED journal; this one watches a LIVE run —
+it tails the journal (and optionally the structured heartbeat + flight
+dump next to it) into a one-screen refresh: step cadence and
+throughput, loss, loss-scale, HBM curve, pipeline bubble / overlap
+stamps, serve queue + SLO attainment, the last hang-attribution
+breadcrumb, and the recent alert feed (``monitor/health.py`` rules
+replayed over the tail, plus any ``kind="alert"`` rows an armed monitor
+journaled live).
+
+Modes:
+
+- default: redraw every ``--interval`` seconds until interrupted (ANSI
+  clear; a dumb terminal still gets sequential frames);
+- ``--once``: one frame, then exit;
+- ``--format json`` (with or without ``--once``): one strict-JSON
+  object per frame — the machine consumer's view, parity with
+  ``monitor.report --format json``.
+
+Pure host-side stdlib over ``MetricsJournal.read`` (crash-tolerant: a
+torn tail renders its good prefix), so it runs anywhere, including
+beside a live run appending to the same file (O_APPEND discipline).
+
+No reference-file citation: like the rest of apex_tpu.monitor, NVIDIA
+Apex has no telemetry layer; this is the operator console veScale-style
+production visibility asks for (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def _last(vals: List[Any]) -> Optional[Any]:
+    return vals[-1] if vals else None
+
+
+def snapshot(
+    records: Sequence[Dict[str, Any]],
+    *,
+    heartbeat_path: Optional[str] = None,
+    flight_path: Optional[str] = None,
+    tail: int = 64,
+    max_alerts: int = 8,
+) -> Dict[str, Any]:
+    """One status frame from a journal's records (+ optional heartbeat/
+    flight files). All fields best-effort: a young run shows what it
+    has."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    recent = steps[-tail:]
+    out: Dict[str, Any] = {
+        "ts": round(time.time(), 3),
+        "records": len(records),
+        "step_records": len(steps),
+        "truncated": bool(getattr(records, "truncated", False)),
+    }
+    meta = next((r for r in records if r.get("kind") == "meta"), None)
+    if meta and meta.get("run"):
+        out["run"] = meta["run"]
+    if recent:
+        last = recent[-1]
+        out["last_step"] = last.get("step", last.get("window"))
+        out["loss"] = last.get("loss")
+        out["loss_scale"] = last.get("loss_scale")
+        out["overflows"] = last.get("overflows")
+        rates = [r["tokens_per_sec"] for r in recent
+                 if isinstance(r.get("tokens_per_sec"), (int, float))]
+        if rates:
+            out["tokens_per_sec"] = round(rates[-1], 1)
+        ts = [r["ts"] for r in recent
+              if isinstance(r.get("ts"), (int, float))]
+        if len(ts) >= 2 and ts[-1] > ts[0]:
+            out["steps_per_sec"] = round((len(ts) - 1) / (ts[-1] - ts[0]), 3)
+        if isinstance(ts and ts[-1], (int, float)):
+            out["last_step_age_s"] = round(time.time() - ts[-1], 1)
+        for key in ("bubble_fraction", "overlap_fraction", "queue_depth",
+                    "slot_occupancy", "accepted_len", "mfu"):
+            vals = [r[key] for r in recent
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                out[key] = vals[-1]
+    # HBM: newest sample from step sub-dicts or standalone hbm rows
+    hbm = []
+    for r in records:
+        if r.get("kind") == "hbm" and isinstance(
+                r.get("live_bytes"), (int, float)):
+            hbm.append(r["live_bytes"])
+        elif isinstance(r.get("hbm"), dict) and isinstance(
+                r["hbm"].get("live_bytes"), (int, float)):
+            hbm.append(r["hbm"]["live_bytes"])
+    if hbm:
+        out["hbm"] = {"live_bytes": int(hbm[-1]),
+                      "growth_bytes": int(hbm[-1] - hbm[0]),
+                      "samples": len(hbm)}
+    # serve SLO: the newest window record
+    slo = _last([r for r in records if r.get("kind") == "slo"])
+    if slo:
+        out["slo"] = {k: slo.get(k) for k in
+                      ("window", "attainment", "target",
+                       "goodput_tokens_per_sec") if slo.get(k) is not None}
+    # alert feed: derived over the journal + journaled live rows
+    try:
+        from apex_tpu.monitor import health as health_mod
+
+        derived = health_mod.scan(records)
+    except Exception:  # noqa: BLE001 - status must survive a bad journal
+        derived = []
+    journaled = [r for r in records if r.get("kind") == "alert"]
+    out["alerts"] = {
+        "count": len(derived), "journaled": len(journaled),
+        "recent": [{k: a.get(k) for k in ("rule", "step", "message")}
+                   for a in derived[-max_alerts:]],
+    }
+    # hang attribution: the structured heartbeat's last breadcrumb
+    if heartbeat_path:
+        try:
+            from apex_tpu.monitor.watchdog import Heartbeat
+
+            hb = Heartbeat.read(heartbeat_path)
+        except Exception:  # noqa: BLE001
+            hb = None
+        if hb:
+            out["heartbeat"] = {
+                "age_s": (round(time.time() - hb["ts"], 1)
+                          if isinstance(hb.get("ts"), (int, float))
+                          else None),
+                "stage": hb.get("stage"),
+                "last_op": (hb.get("last_op") or {}).get("op")
+                if isinstance(hb.get("last_op"), dict) else None,
+            }
+    if flight_path and os.path.exists(flight_path):
+        try:
+            from apex_tpu.monitor import flight as flight_mod
+
+            dumpd = flight_mod.load(flight_path)
+        except Exception:  # noqa: BLE001
+            dumpd = None
+        if dumpd:
+            out["flight"] = {"reason": dumpd.get("reason"),
+                             "ts": dumpd.get("ts"),
+                             "last_op": (dumpd.get("last_op") or {}).get("op")
+                             if isinstance(dumpd.get("last_op"), dict)
+                             else None}
+    return out
+
+
+def render(snap: Dict[str, Any], file=None) -> None:
+    file = file or sys.stdout
+    p = lambda *a: print(*a, file=file)  # noqa: E731
+    head = f"run: {snap.get('run', '?')}  records: {snap['records']}"
+    if snap.get("truncated"):
+        head += "  [TRUNCATED TAIL]"
+    p(head)
+    parts = []
+    if snap.get("last_step") is not None:
+        parts.append(f"step {snap['last_step']}")
+    if snap.get("loss") is not None:
+        parts.append(f"loss {snap['loss']:.4f}")
+    if snap.get("loss_scale") is not None:
+        parts.append(f"scale {snap['loss_scale']:.0f}")
+    if snap.get("tokens_per_sec") is not None:
+        parts.append(f"{snap['tokens_per_sec']} tok/s")
+    if snap.get("steps_per_sec") is not None:
+        parts.append(f"{snap['steps_per_sec']} step/s")
+    if snap.get("last_step_age_s") is not None:
+        parts.append(f"last step {snap['last_step_age_s']}s ago")
+    if parts:
+        p("train: " + "  ".join(parts))
+    hbm = snap.get("hbm")
+    if hbm:
+        p(f"hbm: {hbm['live_bytes'] / 1e6:.1f} MB live "
+          f"({hbm['growth_bytes'] / 1e6:+.1f} MB over "
+          f"{hbm['samples']} samples)")
+    tl = [f"{k.split('_')[0]} {snap[k]}" for k in
+          ("bubble_fraction", "overlap_fraction") if snap.get(k) is not None]
+    if tl:
+        p("timeline: " + "  ".join(tl))
+    sv = [f"queue {snap['queue_depth']}" if snap.get("queue_depth")
+          is not None else None,
+          f"occupancy {snap['slot_occupancy']}"
+          if snap.get("slot_occupancy") is not None else None,
+          f"accepted {snap['accepted_len']}"
+          if snap.get("accepted_len") is not None else None]
+    sv = [s for s in sv if s]
+    slo = snap.get("slo")
+    if slo:
+        sv.append(f"slo attainment {slo.get('attainment')}"
+                  + (f"/{slo['target']}" if slo.get("target") is not None
+                     else ""))
+        if slo.get("goodput_tokens_per_sec") is not None:
+            sv.append(f"goodput {slo['goodput_tokens_per_sec']} tok/s")
+    if sv:
+        p("serve: " + "  ".join(sv))
+    hb = snap.get("heartbeat")
+    if hb:
+        p(f"heartbeat: {hb.get('age_s')}s old  stage {hb.get('stage')!r}"
+          + (f"  last op {hb['last_op']}" if hb.get("last_op") else ""))
+    fl = snap.get("flight")
+    if fl:
+        p(f"FLIGHT DUMP: {fl.get('reason')}"
+          + (f" (last op {fl['last_op']})" if fl.get("last_op") else ""))
+    al = snap["alerts"]
+    p(f"alerts: {al['count']}"
+      + (f" ({al['journaled']} journaled live)" if al["journaled"] else ""))
+    for a in al["recent"]:
+        p(f"  [{a['rule']}] step {a.get('step')}: {a.get('message')}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.monitor.status",
+        description="Tail a MetricsJournal (+ heartbeat/flight files) "
+                    "into a one-screen live view.")
+    p.add_argument("journal")
+    p.add_argument("--heartbeat", default=None, metavar="PATH",
+                   help="structured heartbeat file (monitor/watchdog.py) "
+                        "— shows age, stage, and the last breadcrumb")
+    p.add_argument("--flight", default=None, metavar="PATH",
+                   help="flight-dump path to watch (default: "
+                        "<journal>.flight.json)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json: one strict-JSON object per frame "
+                        "(machine consumers)")
+    p.add_argument("--tail", type=int, default=64,
+                   help="step records in the rolling window")
+    args = p.parse_args(list(sys.argv[1:] if argv is None else argv))
+    flight_path = args.flight or (args.journal + ".flight.json")
+
+    def frame() -> Dict[str, Any]:
+        from apex_tpu.monitor.journal import MetricsJournal
+
+        try:
+            records = MetricsJournal.read(args.journal)
+        except OSError:
+            records = []
+        return snapshot(records, heartbeat_path=args.heartbeat,
+                        flight_path=flight_path, tail=args.tail)
+
+    while True:
+        snap = frame()
+        if args.format == "json":
+            print(json.dumps(snap, default=str, allow_nan=False))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            render(snap)
+        if args.once:
+            return 0
+        sys.stdout.flush()
+        try:
+            time.sleep(max(args.interval, 0.2))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

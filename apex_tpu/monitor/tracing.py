@@ -97,6 +97,16 @@ def fetch_barrier(value) -> None:
     or the scalar itself. Never raises — a failed barrier means the
     span closes on the host clock instead of killing the run."""
     try:
+        # hang-attribution breadcrumb (monitor/flight.py): a wedged
+        # tunnel hangs HERE — stamp before blocking so a watchdog kill
+        # report names the fetch (shape included when cheap to read)
+        from apex_tpu.monitor import flight as _flight
+
+        _flight.breadcrumb(
+            f"fetch:barrier{list(getattr(value, 'shape', ()) or ())}")
+    except Exception:  # noqa: BLE001 - telemetry must not kill training
+        pass
+    try:
         import numpy as np
 
         if getattr(value, "ndim", 0):
@@ -216,6 +226,14 @@ class Tracer:
                     self._since_flush = 0
             if self.keep:
                 self.records.append(rec)
+        except Exception:  # noqa: BLE001 - telemetry must not kill training
+            pass
+        try:
+            # black-box feed: span records ride the armed flight ring
+            # (monitor/flight.py) — one module-global check disarmed
+            from apex_tpu.monitor import flight as _flight
+
+            _flight.observe_record(rec)
         except Exception:  # noqa: BLE001 - telemetry must not kill training
             pass
         return rec

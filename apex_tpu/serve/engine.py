@@ -111,6 +111,17 @@ class ServeConfig:
     # speculative decoding: draft tokens proposed per slot per tick
     # (0 = off; > 0 needs temperature == 0 — greedy-exact verification)
     spec_k: int = 0
+    # -- SLO monitoring (ISSUE 14) -------------------------------------------
+    # latency targets in milliseconds (None = untracked). With either set
+    # AND a journal passed to run(), the engine emits one kind="slo"
+    # record per slo_window ticks: attainment (fraction of first tokens
+    # within slo_ttft_ms + decode tokens within slo_itl_ms) and goodput
+    # (in-SLO tokens/s). Host-side counters only — the compiled prefill/
+    # decode programs are untouched (byte-identity discipline).
+    slo_ttft_ms: Optional[float] = None
+    slo_itl_ms: Optional[float] = None
+    slo_window: int = 32        # engine ticks per SLO window record
+    slo_target: float = 0.99    # attainment the slo-burn health rule gates
 
     def resolved(self) -> "ServeConfig":
         pf = self.prefill_len or self.max_seq
@@ -256,6 +267,13 @@ class Engine:
         self.accepted_total = 0
         self.accept_events = 0  # (slot, tick) commits: the mean's divisor
         self.spec_ticks = 0
+        # -- SLO window counters (ISSUE 14; host-side only) -----------------
+        self._slo_armed = (cfg.slo_ttft_ms is not None
+                           or cfg.slo_itl_ms is not None)
+        self._slo_window_id = 0
+        self._slo_t0 = time.perf_counter()
+        self._slo_counts = {"ttft_total": 0, "ttft_within": 0,
+                            "itl_total": 0, "itl_within": 0}
         # any of the three features routes prefill through the chunk program
         self._chunk_armed = bool(cfg.prefix_cache or cfg.prefill_chunk
                                  or cfg.spec_k)
@@ -719,6 +737,7 @@ class Engine:
             req.tokens.append(first)
             req.ttft_s = (t - req.arrival_s
                           if req.arrival_s is not None else None)
+            self._slo_note_ttft(req.ttft_s)
             self._lengths[slot] = plen
             self._last_token[slot] = first
             self._active[slot] = True
@@ -809,6 +828,7 @@ class Engine:
         req.tokens.append(first)
         req.ttft_s = (t - req.arrival_s
                       if req.arrival_s is not None else None)
+        self._slo_note_ttft(req.ttft_s)
         self._lengths[slot] = plen
         self._last_token[slot] = first
         self._active[slot] = True
@@ -874,6 +894,65 @@ class Engine:
                     "e2e_s": round(gen_s, 6),
                 })
 
+    # -- SLO window accounting (ISSUE 14) ------------------------------------
+
+    def _slo_note_ttft(self, ttft_s: Optional[float]) -> None:
+        # an untargeted category stays OUT of both sides of the
+        # attainment fraction — counting it as "within" would dilute a
+        # 100%-miss on the targeted one below the burn threshold
+        t = self.config.slo_ttft_ms
+        if t is None or ttft_s is None:
+            return
+        c = self._slo_counts
+        c["ttft_total"] += 1
+        if 1e3 * ttft_s <= t:
+            c["ttft_within"] += 1
+
+    def _slo_note_itl(self, dt_s: float, n: int = 1) -> None:
+        t = self.config.slo_itl_ms
+        if t is None:
+            return  # untargeted: excluded from attainment (see above)
+        c = self._slo_counts
+        c["itl_total"] += n
+        if 1e3 * dt_s <= t:
+            c["itl_within"] += n
+
+    def _slo_tick(self, journal, force: bool = False) -> None:
+        """Close an SLO window every ``slo_window`` ticks: one
+        ``kind="slo"`` journal record with attainment (fraction of
+        tokens inside their TTFT/ITL targets) and goodput (in-SLO
+        tokens/s) — the per-window burn signal the ``slo-burn`` health
+        rule (monitor/health.py) and ``report``'s slo section consume.
+        Host-side counters only; no-op unless targets are set."""
+        if not self._slo_armed or (not force
+                                   and self.ticks % self.config.slo_window):
+            return
+        c = self._slo_counts
+        total = c["ttft_total"] + c["itl_total"]
+        now = time.perf_counter()
+        if total and journal is not None:
+            elapsed = max(now - self._slo_t0, 1e-9)
+            within = c["ttft_within"] + c["itl_within"]
+            rec = {
+                "kind": "slo", "window": self._slo_window_id,
+                "ticks": self.config.slo_window,
+                "attainment": round(within / total, 4),
+                "target": self.config.slo_target,
+                "slo_ttft_ms": self.config.slo_ttft_ms,
+                "slo_itl_ms": self.config.slo_itl_ms,
+                **c,
+            }
+            if self.config.slo_itl_ms is not None:
+                # goodput = in-ITL-SLO tokens/s; meaningless (always 0)
+                # without an ITL target
+                rec["goodput_tokens_per_sec"] = round(
+                    c["itl_within"] / elapsed, 1)
+            journal.log(rec)
+        self._slo_window_id += 1
+        self._slo_t0 = now
+        self._slo_counts = {"ttft_total": 0, "ttft_within": 0,
+                            "itl_total": 0, "itl_within": 0}
+
     def _decoding(self) -> Dict[int, Request]:
         """Seated slots that finished prefill and still owe tokens
         (chunked prefill leaves a slot seated-but-inactive until its last
@@ -910,6 +989,7 @@ class Engine:
             self._last_token[slot] = tok
             if self._last_tok_t[slot] is not None:
                 req.itl_s.append(t - self._last_tok_t[slot])
+                self._slo_note_itl(req.itl_s[-1])
             self._last_tok_t[slot] = t
         if journal is not None:
             journal.step_end(
@@ -974,6 +1054,7 @@ class Engine:
             if self._last_tok_t[slot] is not None:
                 dt = t - self._last_tok_t[slot]
                 req.itl_s.extend([dt / a] * a)
+                self._slo_note_itl(dt / a, n=a)
             self._last_tok_t[slot] = t
             accepted.append(a)
         self.accepted_total += sum(accepted)
@@ -1001,6 +1082,10 @@ class Engine:
         """
         for r in requests or ():
             self.submit(r)
+        if self._slo_armed and not any(self._slo_counts.values()):
+            # window 0's clock starts at SERVING start, not engine
+            # construction — compile/idle time must not dilute goodput
+            self._slo_t0 = time.perf_counter()
         results: Dict[Any, Request] = {}
         while not self.batcher.idle:
             if max_ticks is not None and self.ticks >= max_ticks:
@@ -1017,8 +1102,11 @@ class Engine:
                 self._decode_tick(journal)
             self._retire_finished(journal, results, time.perf_counter())
             self.ticks += 1
+            self._slo_tick(journal)
             if on_tick is not None:
                 on_tick(self)
+        # flush the partial final window so short runs carry SLO rows too
+        self._slo_tick(journal, force=True)
         return results
 
     # -- training-state import ---------------------------------------------

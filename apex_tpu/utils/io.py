@@ -1,0 +1,49 @@
+"""Atomic JSON artifact writes: temp file + rename in one directory.
+
+Evidence scripts (``benchmarks/*.py``) and crash dumps
+(``monitor/flight.py``) publish single-JSON artifacts that later gates
+consume (``report compare``, the driver's evidence checks). A plain
+``open(path, "w")`` torn by a crash or a watchdog SIGKILL leaves a
+truncated file that poisons every later consumer; ``os.replace`` of a
+fully-written temp file in the same directory is atomic on POSIX, so a
+reader sees either the old artifact or the complete new one — never a
+torn half. Same discipline as ``monitor/watchdog.py``'s checkpoint
+protocol, shared here so every ``out/*.json`` writer uses one copy.
+
+No reference-file citation: NVIDIA Apex has no evidence-artifact layer;
+this is repo-local tooling discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def atomic_write_json(path: str, obj: Any, *, indent: int = 1,
+                      default=str) -> str:
+    """Write ``obj`` as JSON to ``path`` atomically (tmp + rename).
+
+    The temp file lives in the target's directory so the rename never
+    crosses filesystems. Raises on serialization/IO errors (an evidence
+    script SHOULD fail loudly when it cannot publish its artifact) but
+    never leaves a torn ``path`` behind — the temp file is unlinked on
+    failure. Returns ``path``.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=indent, default=default)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -65,8 +65,13 @@ table, monitor/mfu.py; override the tunnel chip's measured ceiling via
 APEX_TPU_PEAK_FLOPS / APEX_TPU_PEAK_HBM_GBPS) to that file via
 apex_tpu.monitor.MetricsJournal; BENCH_TRACE=<path> additionally lands
 one measured span per timed window in a monitor.tracing span file
-(chrome://tracing-exportable); unset, the compiled programs are
-byte-identical to un-instrumented rounds. Journals analyze offline with
+(chrome://tracing-exportable); BENCH_FLIGHT=<path> arms the flight
+recorder (apex_tpu/monitor/flight.py): journal/span records and
+breadcrumbs ring in memory and dump to <path> as strict JSON when a
+phase crashes, is SIGTERMed, or is killed by the watchdog (the parent
+writes the kill dump from the structured heartbeat when SIGKILL took
+the child's ring). Unset, the compiled programs are byte-identical to
+un-instrumented rounds. Journals analyze offline with
 `python -m apex_tpu.monitor.report <path>` (percentiles, stalls, spikes,
 HBM trend) and gate with `... report compare A B` (exit 1 on regression).
 """
@@ -1578,6 +1583,10 @@ def _watchdog(cmd=None, env_extra=None):
         checkpoint_env="BENCH_PARTIAL_PATH",
         heartbeat_env="BENCH_HEARTBEAT_PATH",
         env=env,
+        # BENCH_FLIGHT: the child arms its flight recorder from
+        # APEX_TPU_FLIGHT (lazy, monitor/flight.py); after a kill the
+        # parent publishes the kill dump from the structured heartbeat
+        flight_path=os.environ.get("BENCH_FLIGHT") or None,
     )
     lines = (res.stdout or "").strip().splitlines()
     if res.status == "ok" and lines and lines[-1].lstrip().startswith("{"):
@@ -1593,6 +1602,8 @@ def _watchdog(cmd=None, env_extra=None):
                             "JSON line")
     rec.setdefault("errors", {})["watchdog"] = (
         reason + "; printing the last per-stage checkpoint")
+    if res.flight:
+        rec["flight"] = res.flight  # where the black-box dump landed
     print(json.dumps(rec))
     return 0
 
@@ -1607,6 +1618,11 @@ if __name__ == "__main__":
         ensure_jax_compat()
     except Exception:  # noqa: BLE001 - bench must start even if apex_tpu broke
         pass
+    # BENCH_FLIGHT maps onto the library's lazy env arming so every phase
+    # (parent AND the fresh-process GPT subprocesses, which inherit the
+    # env) rings recent records for the crash dump
+    if os.environ.get("BENCH_FLIGHT"):
+        os.environ.setdefault("APEX_TPU_FLIGHT", os.environ["BENCH_FLIGHT"])
     if "--selftest" in sys.argv:
         print(json.dumps({"selftest": selftest()}))
     elif ("--gpt-headline" in sys.argv or "--gpt-degraded" in sys.argv

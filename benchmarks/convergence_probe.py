@@ -37,6 +37,8 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from apex_tpu.utils.io import atomic_write_json  # noqa: E402
+
 import jax
 
 if os.environ.get("JAX_PLATFORMS"):
@@ -164,9 +166,9 @@ def main():
 
     print(json.dumps(record))
     if args.output:
-        os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
-        with open(args.output, "w") as f:
-            json.dump(record, f, indent=1)
+        # atomic (tmp + rename): a crash mid-write must never leave a
+        # torn artifact for a later evidence check to trip on
+        atomic_write_json(args.output, record)
     sys.exit(0 if record["ok"] else 1)
 
 

@@ -31,6 +31,8 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from apex_tpu.utils.io import atomic_write_json  # noqa: E402
+
 import jax
 
 if os.environ.get("JAX_PLATFORMS"):
@@ -128,11 +130,8 @@ def main() -> None:
     }
     print(json.dumps(record))
     if args.output:
-        out_dir = os.path.dirname(args.output)
-        if out_dir:
-            os.makedirs(out_dir, exist_ok=True)
-        with open(args.output, "w") as f:
-            json.dump(record, f, indent=1)
+        # atomic (tmp + rename): no torn artifacts on crash
+        atomic_write_json(args.output, record)
     if not record["ok"]:
         sys.exit(1)
 

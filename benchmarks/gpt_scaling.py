@@ -20,6 +20,8 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from apex_tpu.utils.io import atomic_write_json  # noqa: E402
+
 import jax
 
 if os.environ.get("JAX_PLATFORMS"):
@@ -205,8 +207,10 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
         float(loss)  # compile + execute barrier
         t0 = time.perf_counter()
+        step_losses = []
         for _ in range(steps):
             params, opt_state, loss, _ = train_step(params, opt_state, toks, tgts)
+            step_losses.append(loss)  # scalars retained, fetched after
         loss_val = float(loss)  # host fetch forces the whole chain
         dt = (time.perf_counter() - t0) / steps
         conf = {"dp": dp, "tp": tp, "pp": pp, "layers": eff_layers}
@@ -284,6 +288,22 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             row["timeline"] = tl
         except Exception as e:  # noqa: BLE001 - timeline is best-effort
             row["timeline"] = {"error": str(e)[:120]}
+        try:
+            # health-alert stamp per config (monitor/health.py): the
+            # per-step loss trajectory replayed through the SAME
+            # streaming rules the journals use, so an unhealthy row
+            # (spiking/NaN-ing config) surfaces in scaling_table.json as
+            # a nonzero count instead of hiding behind the final loss
+            from apex_tpu.monitor import health as health_mod
+
+            step_records = [
+                {"kind": "step", "step": i, "loss": float(lv),
+                 "tokens_per_sec": batch * seq / dt, "overflows": 0}
+                for i, lv in enumerate(step_losses)]
+            row["alerts"] = health_mod.summarize(
+                health_mod.scan(step_records))
+        except Exception as e:  # noqa: BLE001 - health stamp is best-effort
+            row["alerts"] = {"error": str(e)[:120]}
         try:
             # static hazard scan per config (apex_tpu/lint/trace.py):
             # lane-padding waste at HBM/custom-call boundaries of THIS
@@ -539,8 +559,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                            else "_zero" if zero else "")
                 cp_tag += "_zb" if pp_schedule == "zerobubble" else ""
                 name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
-                with open(os.path.join(output_dir, name), "w") as f:
-                    json.dump(res, f, indent=1)
+                atomic_write_json(os.path.join(output_dir, name), res)
     if big_rung:
         res = placement_rung()
         rows.append(res)
@@ -550,11 +569,12 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             c = res["config"]
             name = (f"scaling_placement_dp{c['dp']}_h{c['hidden']}"
                     f"_l{c['layers']}_zero3.json")
-            with open(os.path.join(output_dir, name), "w") as f:
-                json.dump(res, f, indent=1)
+            atomic_write_json(os.path.join(output_dir, name), res)
     if output_dir:
-        with open(os.path.join(output_dir, "scaling_table.json"), "w") as f:
-            json.dump({"notes": _TABLE_NOTES, "rows": rows}, f, indent=1)
+        # atomic (tmp + rename): a crash mid-sweep must never leave a
+        # torn table for a later evidence consumer
+        atomic_write_json(os.path.join(output_dir, "scaling_table.json"),
+                          {"notes": _TABLE_NOTES, "rows": rows})
     # the human-readable table the reference prints as
     # "Average Iteration Time" lines (gpt_scaling_test.py:64-70)
     hdr = (f"{'dp':>3} {'tp':>3} {'pp':>3} {'cp':>3} {'mode':>5} "

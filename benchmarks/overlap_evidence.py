@@ -94,6 +94,8 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from apex_tpu.utils.io import atomic_write_json  # noqa: E402
+
 import jax
 
 if os.environ.get("JAX_PLATFORMS"):
@@ -645,9 +647,7 @@ def _qcomm_main(args) -> int:
     record["ok"] = bool(ok_census and ok_bytes and ok_ef)
     print(json.dumps(record))
     output = args.output or os.path.join("out", "qcomm_evidence.json")
-    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
-    with open(output, "w") as f:
-        json.dump(record, f, indent=1)
+    atomic_write_json(output, record)  # atomic: no torn artifacts
     return 0 if record["ok"] else 1
 
 
@@ -736,9 +736,7 @@ def _zero3_main(args) -> int:
     record["ok"] = bool(ok_census and ok_bytes and ok_report and ok_rung)
     print(json.dumps(record))
     output = args.output or os.path.join("out", "zero3_evidence.json")
-    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
-    with open(output, "w") as f:
-        json.dump(record, f, indent=1)
+    atomic_write_json(output, record)  # atomic: no torn artifacts
     return 0 if record["ok"] else 1
 
 
@@ -789,9 +787,7 @@ def _zero_main(args) -> int:
     record["ok"] = bool(ok)
     print(json.dumps(record))
     output = args.output or os.path.join("out", "zero_evidence.json")
-    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
-    with open(output, "w") as f:
-        json.dump(record, f, indent=1)
+    atomic_write_json(output, record)  # atomic: no torn artifacts
     return 0 if record["ok"] else 1
 
 
@@ -1191,8 +1187,7 @@ def _timeline_main(args) -> int:
                 "zero3_fracs_sum_1", "chrome_export_loadable")
     record["ok"] = all(record["checks"].get(k) for k in required)
     print(json.dumps(record))
-    with open(output, "w") as f:
-        json.dump(record, f, indent=1)
+    atomic_write_json(output, record)  # atomic: no torn artifacts
     return 0 if record["ok"] else 1
 
 
@@ -1342,9 +1337,7 @@ def main():
 
     print(json.dumps(record))
     if args.output:
-        os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
-        with open(args.output, "w") as f:
-            json.dump(record, f, indent=1)
+        atomic_write_json(args.output, record)  # atomic: no torn artifacts
     sys.exit(0 if record.get("ok") else 1)
 
 

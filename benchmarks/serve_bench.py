@@ -37,6 +37,8 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from apex_tpu.utils.io import atomic_write_json  # noqa: E402
+
 import jax
 
 if os.environ.get("JAX_PLATFORMS"):
@@ -388,9 +390,9 @@ def main() -> int:
         "note": ("latency magnitudes are a contended-CPU-container "
                  "measurement; the gated claims are the structural checks"),
     }
-    os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
-    with open(args.output, "w") as f:
-        json.dump(record, f, indent=1)
+    # atomic (tmp + rename): a crash mid-write must never poison a
+    # later `report compare` / evidence check with a torn artifact
+    atomic_write_json(args.output, record)
     print(json.dumps({"ok": record["ok"],
                       "checks": {k: v for k, v in checks.items() if not v}
                       or "all passed",

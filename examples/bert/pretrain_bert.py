@@ -58,7 +58,15 @@ def parse_args():
                    help="write a span trace (apex_tpu.monitor.tracing): "
                         "one barriered span per step plus a Chrome "
                         "trace-event export next to PATH")
+    p.add_argument("--flight", nargs="?", const="auto", default=None,
+                   metavar="PATH",
+                   help="arm the flight recorder (apex_tpu.monitor."
+                        "flight): recent records + breadcrumbs dumped as "
+                        "strict JSON on crash/SIGTERM/watchdog kill. "
+                        "Default PATH: out/pretrain_bert.flight.json")
     args = p.parse_args()
+    if args.flight == "auto":
+        args.flight = "out/pretrain_bert.flight.json"
     if args.zero_level is not None:
         args.zero = True
     elif args.zero:
@@ -199,6 +207,12 @@ def main():
         tracer = tracing.arm(args.trace,
                              meta={"run": "pretrain_bert",
                                    "zero_level": args.zero_level or 0})
+    if args.flight:
+        from apex_tpu.monitor import flight as flight_mod
+
+        flight_mod.arm(args.flight,
+                       meta={"run": "pretrain_bert",
+                             "zero_level": args.zero_level or 0})
     rng = np.random.default_rng(0)
     t0 = time.perf_counter()
     for i in range(args.steps):
@@ -228,6 +242,10 @@ def main():
                                        args.trace + ".chrome.json")
         except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
             print(f"chrome export failed: {e}")
+    if args.flight:
+        from apex_tpu.monitor import flight as flight_mod
+
+        flight_mod.disarm()  # clean exit: restore hooks, no dump
     n = max(args.steps - 1, 1)
     dt = (time.perf_counter() - t0) / n
     print(f"{args.batch * args.seq / dt:.0f} tokens/s "

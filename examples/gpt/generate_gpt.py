@@ -104,7 +104,25 @@ def parse_args():
                    help="write serve.prefill/serve.decode spans "
                         "(apex_tpu.monitor.tracing) + a Chrome export "
                         "next to PATH")
-    return p.parse_args()
+    p.add_argument("--flight", nargs="?", const="auto", default=None,
+                   metavar="PATH",
+                   help="arm the flight recorder (apex_tpu.monitor."
+                        "flight): recent tick/request records + "
+                        "breadcrumbs dumped as strict JSON on crash/"
+                        "SIGTERM/watchdog kill. Default PATH: "
+                        "<journal>.flight.json")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT target in ms: with --journal, the engine "
+                        "emits per-window kind=\"slo\" attainment/goodput "
+                        "records (monitor.report slo section; the "
+                        "slo-burn health rule gates attainment)")
+    p.add_argument("--slo-itl-ms", type=float, default=None,
+                   help="ITL target in ms (see --slo-ttft-ms)")
+    args = p.parse_args()
+    if args.flight == "auto":
+        args.flight = ((args.journal + ".flight.json") if args.journal
+                       else "out/generate_gpt.flight.json")
+    return args
 
 
 def load_prompts(args) -> list:
@@ -157,13 +175,22 @@ def main():
     journal = None
     if args.journal:
         from apex_tpu.monitor import MetricsJournal
+        from apex_tpu.monitor.health import HealthMonitor
 
         journal = MetricsJournal(
             args.journal,
             meta={"run": "generate_gpt", "tp": args.tp,
                   "max_batch": args.max_batch, "max_seq": args.max_seq,
                   "block_size": args.block_size,
-                  "window": args.window or 0})
+                  "window": args.window or 0},
+            # stream every tick/request/slo record through the online
+            # health rules; alerts land in this journal
+            health=HealthMonitor())
+    if args.flight:
+        from apex_tpu.monitor import flight as flight_mod
+
+        flight_mod.arm(args.flight,
+                       meta={"run": "generate_gpt", "tp": args.tp})
 
     draft_model = draft_params = None
     if args.spec_k and args.draft_layers:
@@ -177,7 +204,9 @@ def main():
         block_size=args.block_size, temperature=args.temperature,
         top_k=args.top_k, seed=args.seed,
         prefix_cache=args.prefix_cache, prefill_chunk=args.prefill_chunk,
-        spec_k=args.spec_k), mesh=mesh,
+        spec_k=args.spec_k,
+        slo_ttft_ms=args.slo_ttft_ms, slo_itl_ms=args.slo_itl_ms),
+        mesh=mesh,
         draft_model=draft_model, draft_params=draft_params)
     prompts = load_prompts(args)
     budget = args.max_seq - args.max_new_tokens
@@ -205,6 +234,10 @@ def main():
 
     if journal is not None:
         journal.close()
+    if args.flight:
+        from apex_tpu.monitor import flight as flight_mod
+
+        flight_mod.disarm()  # clean exit: restore hooks, no dump
     if tracer is not None:
         from apex_tpu.monitor import tracing
 

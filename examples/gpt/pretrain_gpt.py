@@ -126,7 +126,19 @@ def parse_args():
                         "tick drive measuring per-rank bubble fraction "
                         "(pp>1, tp=1), and a Chrome trace-event export "
                         "next to PATH (chrome://tracing / Perfetto)")
+    p.add_argument("--flight", nargs="?", const="auto", default=None,
+                   metavar="PATH",
+                   help="arm the flight recorder (apex_tpu.monitor."
+                        "flight): a bounded in-memory ring of recent "
+                        "journal/span records + breadcrumbs dumped as "
+                        "strict JSON on unhandled exception, SIGTERM, or "
+                        "watchdog kill — with an HBM snapshot and the "
+                        "last loss-scale state. Default PATH: "
+                        "<journal>.flight.json")
     args = p.parse_args()
+    if args.flight == "auto":
+        args.flight = ((args.journal + ".flight.json") if args.journal
+                       else "out/pretrain_gpt.flight.json")
     if args.zero_level is not None:
         args.zero = True
     elif args.zero:
@@ -225,6 +237,16 @@ def main():
             args.trace,
             meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
                   "zero_level": args.zero_level or 0})
+    if args.flight:
+        # black box (monitor/flight.py): journal/span records and
+        # breadcrumbs ring in memory; a crash/SIGTERM/watchdog kill dumps
+        # them with an HBM snapshot — disarmed runs are byte-identical
+        from apex_tpu.monitor import flight as flight_mod
+
+        flight_mod.arm(args.flight,
+                       meta={"run": "pretrain_gpt", "tp": args.tp,
+                             "pp": args.pp, "dp": dp,
+                             "zero_level": args.zero_level or 0})
 
     batch = args.micro_batch * dp * args.num_microbatches
     data_spec = P(mesh_lib.AXIS_DATA)
@@ -363,13 +385,20 @@ def main():
         )
         from apex_tpu.monitor import mfu as mfu_lib
 
+        from apex_tpu.monitor.health import HealthMonitor
+
         journal = MetricsJournal(
             args.journal, sample_hbm_every=10,
             meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
                   "dp": dp, "hidden": args.hidden, "layers": args.layers,
                   "seq": args.seq, "batch": batch, "zero": bool(args.zero),
                   "zero_level": args.zero_level or 0,
-                  "reduce_dtype": args.reduce_dtype or "fp32"})
+                  "reduce_dtype": args.reduce_dtype or "fp32"},
+            # online health rules (monitor/health.py): every record
+            # streams through the detectors; kind="alert" rows land in
+            # this same journal for report's alerts section and the
+            # `report compare --max-alerts` gate
+            health=HealthMonitor())
         try:
             # per-rank residency footprints (monitor/hbm.py): the ZeRO
             # bytes/rank ÷ dp claim — and under --zero-level 3 the
@@ -507,6 +536,10 @@ def main():
             print(f"chrome trace: {args.trace}.chrome.json")
         except Exception as e:  # noqa: BLE001
             print(f"chrome export failed: {e}")
+    if args.flight:
+        from apex_tpu.monitor import flight as flight_mod
+
+        flight_mod.disarm()  # clean exit: restore hooks, no dump
     n_done = max(args.steps - 1, 1)
     dt = (time.perf_counter() - t0) / n_done
     print(f"{batch * args.seq / dt:.0f} tokens/s | mesh: tp={args.tp} pp={args.pp} "

@@ -1,0 +1,546 @@
+"""Tests for the ISSUE 14 observability layer: flight recorder (ring,
+crash dump, tolerant load), hang-attribution breadcrumbs + structured
+heartbeat (watchdog kill report names the last operation, torn files
+salvage), online health rules + journal wiring, the report alerts
+section / ``--max-alerts`` gate / ``--format json``, the status CLI, the
+serve SLO window records, and the disarmed byte-identity pin. All
+CPU-mesh safe (conftest forces 8 virtual devices)."""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.monitor import flight, health, report, status
+from apex_tpu.monitor.journal import MetricsJournal
+from apex_tpu.monitor.watchdog import Heartbeat, run_under_watchdog
+
+
+@pytest.fixture(autouse=True)
+def _disarm_flight():
+    """Every test starts and ends with no global recorder (module state)."""
+    flight.disarm()
+    yield
+    flight.disarm()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, dump, tolerant load
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_dump_round_trip(tmp_path):
+    jpath = str(tmp_path / "run.jsonl")
+    fpath = jpath + ".flight.json"
+    fr = flight.arm(fpath, meta={"run": "t"}, capacity=32, hooks=False)
+    with MetricsJournal(jpath) as j:
+        for step in range(4):
+            j.step_start()
+            j.step_end(step=step, loss=jnp.asarray(2.0, jnp.float32),
+                       tokens=256, metrics={"loss_scale": 1024.0,
+                                            "found_inf": False})
+    flight.breadcrumb("comm:ppermute[pipe]")
+    assert flight.dump("explicit") == fpath
+    dump = flight.load(fpath)
+    steps = [r for r in dump["ring"] if r.get("kind") == "step"]
+    assert len(steps) == 4 and steps[-1]["step"] == 3
+    assert dump["reason"] == "explicit" and dump["meta"] == {"run": "t"}
+    assert dump["last_op"]["op"] == "comm:ppermute[pipe]"
+    assert dump["scaler"]["loss_scale"] == 1024.0
+    assert isinstance(dump["hbm"], dict)
+    # strict JSON: reparse the raw file
+    with open(fpath) as f:
+        json.loads(f.read())
+
+
+def test_flight_ring_is_bounded(tmp_path):
+    fr = flight.arm(str(tmp_path / "f.json"), capacity=16, hooks=False)
+    for i in range(100):
+        flight.observe_record({"kind": "step", "step": i})
+    assert len(fr.ring) == 16
+    assert fr.ring[-1]["step"] == 99 and fr.ring[0]["step"] == 84
+
+
+def test_flight_dump_sanitizes_nonfinite(tmp_path):
+    fr = flight.arm(str(tmp_path / "f.json"), hooks=False)
+    fr.note({"kind": "step", "loss": float("nan")})
+    path = fr.dump("explicit")
+    with open(path) as f:
+        dump = json.loads(f.read())  # bare NaN would fail strict parse
+    assert dump["ring"][0]["loss"] is None
+    assert any("loss" in k for k in dump["nonfinite_keys"])
+
+
+def test_flight_load_degrades_on_corrupt_file(tmp_path):
+    p = tmp_path / "torn.flight.json"
+    p.write_text('{"v": 1, "ring": [{"kind": "st')
+    assert flight.load(str(p)) is None
+    assert flight.load(str(tmp_path / "absent.json")) is None
+
+
+def test_flight_excepthook_dumps_and_chains(tmp_path):
+    fpath = str(tmp_path / "crash.flight.json")
+    calls = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: calls.append(a)
+    try:
+        flight.arm(fpath, hooks=True)
+        flight.observe_record({"kind": "step", "step": 7})
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError as e:
+            sys.excepthook(type(e), e, e.__traceback__)
+    finally:
+        flight.disarm()
+        sys.excepthook = prev
+    dump = flight.load(fpath)
+    assert dump["reason"] == "unhandled_exception"
+    assert dump["exception"]["type"] == "RuntimeError"
+    assert dump["ring"][0]["step"] == 7
+    assert len(calls) == 1  # the previous hook still ran (chained)
+
+
+def test_flight_disarm_restores_hooks(tmp_path):
+    prev_hook = sys.excepthook
+    flight.arm(str(tmp_path / "f.json"), hooks=True)
+    assert sys.excepthook is not prev_hook
+    flight.disarm()
+    assert sys.excepthook is prev_hook
+    assert flight.get_recorder() is None
+
+
+# ---------------------------------------------------------------------------
+# breadcrumbs + structured heartbeat
+# ---------------------------------------------------------------------------
+
+
+def test_comm_scope_stamps_breadcrumb():
+    from apex_tpu.monitor.comms import collective_scope
+
+    with collective_scope("psum", "data", jnp.ones((4,))):
+        pass
+    assert flight.last_op()["op"] == "comm:psum[data]"
+
+
+def test_fetch_barrier_stamps_breadcrumb():
+    from apex_tpu.monitor.tracing import fetch_barrier
+
+    fetch_barrier(jnp.ones((3, 2)))
+    assert flight.last_op()["op"] == "fetch:barrier[3, 2]"
+
+
+def test_journal_loss_fetch_stamps_breadcrumb(tmp_path):
+    with MetricsJournal(str(tmp_path / "j.jsonl")) as j:
+        j.step_start()
+        j.step_end(step=11, loss=jnp.asarray(1.0), tokens=8)
+    assert flight.last_op()["op"] == "fetch:loss[step=11]"
+
+
+def test_heartbeat_carries_last_op_pid_seq(tmp_path):
+    path = str(tmp_path / "hb.json")
+    flight.breadcrumb("comm:all_gather[model]")
+    hb = Heartbeat(path)
+    hb.beat("stage-a")
+    hb.beat("stage-b")
+    got = Heartbeat.read(path)
+    assert got["stage"] == "stage-b" and got["seq"] == 2
+    assert got["pid"] > 0
+    assert got["last_op"]["op"] == "comm:all_gather[model]"
+
+
+def test_heartbeat_read_salvages_torn_file(tmp_path):
+    p = tmp_path / "hb.json"
+    p.write_text('{"ts": 1.0, "stage": "train", '
+                 '"last_op": {"op": "comm:psum[data]", "ts": 1.')
+    got = Heartbeat.read(str(p))
+    assert got["salvaged"] is True
+    assert got["stage"] == "train"
+    assert got["last_op"]["op"] == "comm:psum[data]"
+    # nothing recoverable -> None, never a raise
+    p.write_text("\x00\x01 garbage")
+    assert Heartbeat.read(str(p)) is None
+
+
+def test_breadcrumb_refreshes_heartbeat_via_env(tmp_path, monkeypatch):
+    path = str(tmp_path / "hb.json")
+    monkeypatch.setenv(Heartbeat.ENV, path)
+    flight.reset_heartbeat_cache()
+    try:
+        flight.set_stage("train")
+        flight.breadcrumb("comm:psum_scatter[data]")
+        got = Heartbeat.read(path)
+        assert got["stage"] == "train"
+        assert got["last_op"]["op"] == "comm:psum_scatter[data]"
+    finally:
+        flight.reset_heartbeat_cache()
+
+
+# ---------------------------------------------------------------------------
+# watchdog: the kill report names the breadcrumbed operation
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_stall_kill_names_breadcrumb(tmp_path):
+    """A stdlib-only child (fast start, ``-S``: no jax) writes the
+    structured heartbeat the breadcrumb path produces — stage + last_op
+    — and wedges: the stall kill's reason must name the operation, and
+    the parent must publish the kill dump at the advertised flight path.
+    (The full in-library breadcrumb→heartbeat chain is covered by
+    test_breadcrumb_refreshes_heartbeat_via_env and, end-to-end with a
+    real ``comm:`` scope, by benchmarks/flight_evidence.py.)"""
+    code = (
+        "import json, os, time\n"
+        "hb = os.environ['APEX_TPU_HEARTBEAT_PATH']\n"
+        "with open(hb, 'w') as f:\n"
+        "    json.dump({'ts': time.time(), 'stage': 'train', 'pid': 1,\n"
+        "               'seq': 1,\n"
+        "               'last_op': {'op': 'comm:psum[data]'}}, f)\n"
+        "time.sleep(60)\n"
+    )
+    fpath = str(tmp_path / "kill.flight.json")
+    t0 = time.time()
+    res = run_under_watchdog([sys.executable, "-S", "-c", code],
+                             deadline=300, stall_timeout=1.5, poll_s=0.1,
+                             flight_path=fpath)
+    assert time.time() - t0 < 30
+    assert res.status == "stalled"
+    assert "last op: comm:psum[data]" in res.reason, res.reason
+    assert "last stage: train" in res.reason, res.reason
+    assert res.flight == fpath
+    dump = flight.load(fpath)
+    assert dump["last_op"]["op"] == "comm:psum[data]"
+    assert dump["writer"] == "watchdog-parent"
+
+
+def test_watchdog_stall_kill_salvages_torn_heartbeat():
+    """A child that dies mid-heartbeat-write leaves a TORN file: the
+    tolerant read must salvage stage/last_op so the kill report still
+    names the breadcrumbed operation instead of crashing or reporting
+    nothing."""
+    code = (
+        "import os, time\n"
+        "hb = os.environ['APEX_TPU_HEARTBEAT_PATH']\n"
+        "with open(hb, 'w') as f:\n"
+        "    f.write('{\"ts\": 1.0, \"stage\": \"apply\", '\n"
+        "            '\"last_op\": {\"op\": \"fetch:loss[step=9]\", \"ts')\n"
+        "time.sleep(60)\n"
+    )
+    res = run_under_watchdog([sys.executable, "-S", "-c", code],
+                             deadline=300, stall_timeout=1.5, poll_s=0.1)
+    assert res.status == "stalled"
+    assert "last stage: apply" in res.reason, res.reason
+    assert "last op: fetch:loss[step=9]" in res.reason, res.reason
+    assert res.heartbeat["salvaged"] is True
+
+
+def test_write_kill_dump_defers_to_child_dump(tmp_path):
+    p = str(tmp_path / "f.json")
+    with open(p, "w") as f:
+        json.dump({"v": 1, "reason": "child"}, f)
+    assert not flight.write_kill_dump(p, reason="r", status="stalled")
+    assert flight.load(p)["reason"] == "child"
+
+
+def test_write_kill_dump_overwrites_stale_artifact(tmp_path):
+    """A dump left by a PREVIOUS run (older than this child's start)
+    must not suppress this kill's evidence."""
+    import os
+
+    p = str(tmp_path / "f.json")
+    with open(p, "w") as f:
+        json.dump({"v": 1, "reason": "yesterday"}, f)
+    os.utime(p, (time.time() - 3600, time.time() - 3600))
+    assert flight.write_kill_dump(p, reason="r", status="stalled",
+                                  newer_than=time.time() - 60)
+    assert flight.load(p)["reason"] == "r"
+    # and a FRESH child dump still wins against the same threshold
+    with open(p, "w") as f:
+        json.dump({"v": 1, "reason": "child"}, f)
+    assert not flight.write_kill_dump(p, reason="r2", status="stalled",
+                                      newer_than=time.time() - 60)
+    assert flight.load(p)["reason"] == "child"
+
+
+def test_disarm_clears_breadcrumb_state(tmp_path):
+    flight.arm(str(tmp_path / "f.json"), hooks=False)
+    flight.breadcrumb("comm:psum[data]")
+    flight.set_stage("train")
+    flight.disarm()
+    assert flight.last_op() is None
+
+
+# ---------------------------------------------------------------------------
+# health rules
+# ---------------------------------------------------------------------------
+
+
+def _steps(n, **overrides):
+    out = []
+    for s in range(n):
+        rec = {"kind": "step", "step": s, "loss": 2.0 - 0.01 * s,
+               "tokens_per_sec": 1000.0, "grad_norm": 1.0, "overflows": 0}
+        rec.update({k: (v(s) if callable(v) else v)
+                    for k, v in overrides.items()})
+        out.append(rec)
+    return out
+
+
+def test_health_clean_run_fires_nothing():
+    assert health.scan(_steps(40)) == []
+
+
+def test_health_loss_spike_fires_exactly_once():
+    recs = _steps(20)
+    recs[15]["loss"] = 60.0
+    fired = health.scan(recs)
+    assert [a["rule"] for a in fired] == ["loss-spike"]
+    assert fired[0]["step"] == 15
+
+
+def test_health_overflow_steps_excluded_from_spike():
+    recs = _steps(20)
+    recs[15]["loss"] = 60.0
+    recs[15]["found_inf"] = True  # overflow wins; not a spike
+    assert health.scan(recs) == []
+
+
+def test_health_grad_norm_drift():
+    recs = _steps(20)
+    recs[12]["grad_norm"] = 100.0
+    fired = health.scan(recs)
+    assert [a["rule"] for a in fired] == ["grad-norm-drift"]
+
+
+def test_health_throughput_collapse():
+    recs = _steps(20)
+    for r in recs[12:]:
+        r["tokens_per_sec"] = 100.0
+    fired = health.scan(recs)
+    assert fired and fired[0]["rule"] == "throughput-collapse"
+    # cooldown de-storms the sustained condition: far fewer alerts than
+    # collapsed records
+    assert len(fired) <= 2
+
+
+def test_health_hbm_growth_rearms():
+    recs = [{"kind": "hbm", "live_bytes": 1_000_000}]
+    for i in range(1, 40):
+        recs.append({"kind": "hbm", "live_bytes": 1_000_000 + i * 50_000_000})
+    fired = health.scan(recs, hbm_slack_bytes=256 << 20, cooldown=0)
+    assert fired and all(a["rule"] == "hbm-growth" for a in fired)
+    assert len(fired) >= 2  # re-armed past each firing (creeping leak)
+
+
+def test_health_overflow_rate_latches():
+    recs = _steps(40, overflows=lambda s: s // 2)  # 50% overflow rate
+    fired = health.scan(recs)
+    assert [a["rule"] for a in fired] == ["overflow-rate"]
+
+
+def test_health_queue_depth_needs_config():
+    recs = _steps(20, queue_depth=50.0)
+    assert health.scan(recs) == []  # off until a limit is configured
+    fired = health.scan(recs, queue_limit=10, queue_consecutive=4)
+    assert fired and fired[0]["rule"] == "queue-depth"
+
+
+def test_health_slo_burn_uses_record_target():
+    rec = {"kind": "slo", "window": 3, "attainment": 0.8, "target": 0.99}
+    fired = health.scan([rec])
+    assert [a["rule"] for a in fired] == ["slo-burn"]
+    assert health.scan([dict(rec, attainment=1.0)]) == []
+
+
+def test_health_rejects_unknown_config():
+    with pytest.raises(TypeError):
+        health.HealthMonitor(not_a_knob=1)
+
+
+def test_journal_health_wiring_appends_alerts(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with MetricsJournal(path, health=health.HealthMonitor()) as j:
+        for rec in _steps(20):
+            j.log(dict(rec))
+        j.log({"kind": "step", "step": 20, "loss": 99.0,
+               "tokens_per_sec": 1000.0, "overflows": 0})
+    rows = MetricsJournal.read(path)
+    alerts = [r for r in rows if r["kind"] == "alert"]
+    assert len(alerts) == 1 and alerts[0]["rule"] == "loss-spike"
+    assert alerts[0]["step"] == 20
+
+
+# ---------------------------------------------------------------------------
+# report: alerts section, --max-alerts, --format json
+# ---------------------------------------------------------------------------
+
+
+def _write_journal(path, recs):
+    with MetricsJournal(str(path)) as j:
+        for r in recs:
+            j.log(dict(r))
+
+
+def test_report_alerts_section(tmp_path):
+    spiked = _steps(20)
+    spiked[15]["loss"] = 60.0
+    _write_journal(tmp_path / "s.jsonl", spiked)
+    an = report.analyze(MetricsJournal.read(str(tmp_path / "s.jsonl")))
+    assert an["alerts"]["count"] == 1
+    assert an["alerts"]["by_rule"] == {"loss-spike": 1}
+    assert an["alerts"]["journaled"] == 0  # no live monitor was wired
+    clean = report.analyze([])
+    assert clean["alerts"]["count"] == 0
+
+
+def test_report_compare_max_alerts_gate(tmp_path, capsys):
+    clean, spiked = _steps(20), _steps(20)
+    spiked[15]["loss"] = 60.0
+    _write_journal(tmp_path / "a.jsonl", clean)
+    _write_journal(tmp_path / "b.jsonl", spiked)
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    assert report.main(["compare", a, b, "--max-alerts", "0"]) == 1
+    assert report.main(["compare", b, b, "--max-alerts", "0"]) == 0  # self
+    assert report.main(["compare", a, b]) == 0  # gate off by default
+    assert report.main(["compare", a, b, "--max-alerts", "1"]) == 0
+    capsys.readouterr()
+
+
+def test_report_format_json_single_journal(tmp_path, capsys):
+    _write_journal(tmp_path / "a.jsonl", _steps(8))
+    assert report.main([str(tmp_path / "a.jsonl"), "--format", "json"]) == 0
+    out = capsys.readouterr().out.strip()
+    obj = json.loads(out)  # ONE strict-JSON object, no text to scrape
+    assert obj["step_records"] == 8 and "alerts" in obj
+    assert len(out.splitlines()) == 1
+
+
+def test_report_format_json_compare(tmp_path, capsys):
+    _write_journal(tmp_path / "a.jsonl", _steps(8))
+    a = str(tmp_path / "a.jsonl")
+    assert report.main(["compare", a, a, "--format", "json"]) == 0
+    obj = json.loads(capsys.readouterr().out.strip())
+    assert obj["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# status CLI
+# ---------------------------------------------------------------------------
+
+
+def test_status_once_json(tmp_path, capsys):
+    spiked = _steps(20)
+    spiked[15]["loss"] = 60.0
+    _write_journal(tmp_path / "run.jsonl", spiked)
+    hb_path = str(tmp_path / "hb.json")
+    flight.breadcrumb("comm:psum[data]")
+    Heartbeat(hb_path).beat("train")
+    rc = status.main([str(tmp_path / "run.jsonl"), "--once",
+                      "--format", "json", "--heartbeat", hb_path])
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out.strip())
+    assert snap["step_records"] == 20
+    assert snap["last_step"] == 19
+    assert snap["alerts"]["count"] == 1
+    assert snap["alerts"]["recent"][0]["rule"] == "loss-spike"
+    assert snap["heartbeat"]["stage"] == "train"
+    assert snap["heartbeat"]["last_op"] == "comm:psum[data]"
+
+
+def test_status_renders_text(tmp_path, capsys):
+    _write_journal(tmp_path / "run.jsonl", _steps(6))
+    rc = status.main([str(tmp_path / "run.jsonl"), "--once"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "alerts: 0" in out and "train:" in out
+
+
+def test_status_tolerates_missing_journal(tmp_path, capsys):
+    rc = status.main([str(tmp_path / "absent.jsonl"), "--once",
+                      "--format", "json"])
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out.strip())
+    assert snap["records"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve SLO windows
+# ---------------------------------------------------------------------------
+
+
+def test_serve_slo_window_records(tmp_path):
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.serve import Engine, Request, ServeConfig
+
+    cfg = GPTConfig(vocab_size=37, hidden_size=16, num_layers=1,
+                    num_attention_heads=2, max_seq_len=32,
+                    hidden_dropout=0.0, axis=None,
+                    compute_dtype=jnp.float32, remat=False)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = Engine(model, params,
+                 ServeConfig(max_batch=2, max_seq=24, block_size=8,
+                             slo_ttft_ms=1e9, slo_itl_ms=1e9, slo_window=4))
+    path = str(tmp_path / "serve.jsonl")
+    with MetricsJournal(path) as j:
+        eng.run([Request(prompt=[3, 1, 4], max_new_tokens=6,
+                         request_id="a"),
+                 Request(prompt=[2, 7], max_new_tokens=5,
+                         request_id="b")], journal=j)
+    rows = MetricsJournal.read(path)
+    slo = [r for r in rows if r["kind"] == "slo"]
+    assert slo, rows
+    for r in slo:
+        assert 0.0 <= r["attainment"] <= 1.0
+        assert r["target"] == 0.99
+        assert r["itl_total"] + r["ttft_total"] > 0 or r is slo[-1]
+    # infinite targets: everything attains
+    assert all(r["attainment"] == 1.0 for r in slo)
+    an = report.analyze(rows)
+    assert an["slo"]["windows"] == len(slo)
+    assert an["slo"]["attainment"]["p50"] == 1.0
+    # a disarmed engine journals no slo rows (byte-identity discipline)
+    eng2 = Engine(model, params,
+                  ServeConfig(max_batch=2, max_seq=24, block_size=8))
+    path2 = str(tmp_path / "serve2.jsonl")
+    with MetricsJournal(path2) as j:
+        eng2.run([Request(prompt=[3, 1, 4], max_new_tokens=3,
+                          request_id="a")], journal=j)
+    assert not [r for r in MetricsJournal.read(path2)
+                if r["kind"] == "slo"]
+    # an UNTARGETED category stays out of the attainment fraction: with
+    # only a TTFT target, decode-token samples must not dilute a miss
+    eng3 = Engine(model, params,
+                  ServeConfig(max_batch=2, max_seq=24, block_size=8,
+                              slo_ttft_ms=1.0))
+    eng3._slo_note_itl(0.001, n=100)   # no ITL target: not counted
+    eng3._slo_note_ttft(10.0)          # 10 s >> 1 ms target: a miss
+    c = eng3._slo_counts
+    assert c["itl_total"] == 0 and c["ttft_total"] == 1
+    assert c["ttft_within"] == 0
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: disarmed (and even armed) flight never touches programs
+# ---------------------------------------------------------------------------
+
+
+def test_flight_arming_keeps_programs_byte_identical(tmp_path):
+    """Flight/health are host-side only: the jitted step's lowered text
+    must be IDENTICAL with the recorder armed (breadcrumbs stamping at
+    every comm scope during trace) and disarmed — the same pin the
+    tracer carries."""
+    from apex_tpu.parallel import collectives
+
+    def step(x):
+        return collectives.pmean(jnp.sum(x * x), "i")
+
+    x = jnp.ones((8, 4), jnp.float32)
+    fn = jax.vmap(step, axis_name="i")
+    baseline = jax.jit(fn).lower(x).as_text()
+    flight.arm(str(tmp_path / "f.json"), hooks=False)
+    armed = jax.jit(fn).lower(x).as_text()
+    flight.disarm()
+    assert armed == baseline
