@@ -169,10 +169,24 @@ class MixedPrecisionOptimizer:
         #: the UNREDUCED grads is the data-parallel reduction, then a
         #: sharded inner step over 1/n chunks, then an all-gather of the
         #: updated params). init/apply_gradients must then run inside
-        #: shard_map binding the axis — see :meth:`zero_init`. Requires
-        #: every param REPLICATED over the axis (dense models; data-sharded
-        #: params like MoE experts cannot be chunked over their own axis).
+        #: shard_map binding the axis — see :meth:`zero_init`. At levels
+        #: 1/2 params SHARDED over the axis (MoE experts with
+        #: ``moe_expert_axis`` == the zero axis) compose: their masters
+        #: and moments stay the local expert shard (already 1/n of the
+        #: leaf — Xu et al.'s weight-update sharding per parameter group),
+        #: their grads skip the psum_scatter (the all_to_all transpose
+        #: already summed every shard's cotangents) but keep the 1/n
+        #: averaging, and no post-update gather touches them. Level 3
+        #: still requires every param replicated over the axis (the chunk
+        #: drive has no expert-shard story).
         self.zero_axis = zero_axis
+        #: bool tree over the model params (True on leaves SHARDED over
+        #: ``zero_axis`` — expert leaves); None until the ZeRO wiring
+        #: (``zero_abstract_state``/``zero_init``) reads the param specs,
+        #: which also fills ``_zero_expert_specs`` (local shape -> the
+        #: param's own PartitionSpec, for the sharded state's out-specs).
+        self._zero_sharded = None
+        self._zero_expert_specs = None
         #: ZeRO stage under ``zero_axis``. 1/2 (one implementation here:
         #: masters AND moments always shard together) keep the bf16 working
         #: params replicated and all-gather them after every update. 3
@@ -276,8 +290,19 @@ class MixedPrecisionOptimizer:
         return {k: jax.tree.map(lambda _: k in self.stacked_keys, v)
                 for k, v in params.items()}
 
+    def _sharded_tree(self, params) -> Any:
+        """Bool tree: True on leaves SHARDED over the zero axis (expert
+        leaves — recorded by the ZeRO wiring from the param specs); all
+        False when no wiring ran (dense models, ad-hoc test harnesses)."""
+        if self._zero_sharded is None:
+            return jax.tree.map(lambda _: False, params)
+        return self._zero_sharded
+
     def _chunk_tree(self, params, dtype=None):
-        """This rank's chunk of every leaf (stacked-aware at level 3).
+        """This rank's per-leaf ZeRO state: a 1-D chunk of every
+        zero-axis-REPLICATED leaf (stacked-aware at level 3); leaves
+        SHARDED over the zero axis (expert params, levels 1/2) pass
+        through as their local shard — already 1/n of the global leaf.
         Must run inside shard_map (or an axis_env trace) binding the
         zero axis."""
         from apex_tpu.optimizers.distributed import (
@@ -288,12 +313,15 @@ class MixedPrecisionOptimizer:
         n = lax.axis_size(self.zero_axis)
         idx = lax.axis_index(self.zero_axis)
 
-        def chunk(p, st):
+        def chunk(p, st, sh):
             if dtype is not None:
                 p = p.astype(dtype)
+            if sh:
+                return p
             return (local_chunk_stacked if st else local_chunk)(p, n, idx)
 
-        return jax.tree.map(chunk, params, self._stacked_tree(params))
+        return jax.tree.map(chunk, params, self._stacked_tree(params),
+                            self._sharded_tree(params))
 
     def _init_residual(self, model_params):
         """The error-feedback state for the quantized grad reduce-scatter
@@ -306,9 +334,13 @@ class MixedPrecisionOptimizer:
         from apex_tpu.optimizers.distributed import chunk_size
 
         n = lax.axis_size(self.zero_axis)
+        # zero-axis-SHARDED leaves (MoE experts) have no reduce wire —
+        # their grads never leave the rank — so they carry an EMPTY
+        # residual leaf (structure preserved, zero bytes)
         err = jax.tree.map(
-            lambda p: jnp.zeros((chunk_size(p.size, n) * n,), jnp.float32),
-            model_params)
+            lambda p, sh: jnp.zeros(
+                (0,) if sh else (chunk_size(p.size, n) * n,), jnp.float32),
+            model_params, self._sharded_tree(model_params))
         residual = {"err": err}
         if self.stochastic_rounding:
             # per-rank dither stream: senders round independently
@@ -464,6 +496,7 @@ class MixedPrecisionOptimizer:
 
         axis = self.zero_axis
         n = lax.axis_size(axis)
+        sharded = self._sharded_tree(grads32)
         new_residual = state.residual
         if self.reduce_dtype is not None:
             # quantized reduce-scatter (parallel/quantize.py): encoded
@@ -472,19 +505,25 @@ class MixedPrecisionOptimizer:
             # error-feedback residual compensates next step's payload;
             # its update is selected back on overflow below, with the
             # masters, so a skipped step leaves it bit-identical per rank.
+            # Zero-axis-SHARDED leaves (MoE experts) have no wire at all:
+            # their grads arrive complete (the dispatch all_to_all
+            # transpose summed every shard's cotangents) and pass through
+            # with their empty residual leaf untouched.
             from apex_tpu.parallel.quantize import quantized_reduce_scatter
 
             err_tree = state.residual["err"]
             key = state.residual.get("key")
             leaves, treedef = jax.tree.flatten(grads32)
             err_leaves = treedef.flatten_up_to(err_tree)
+            sh_leaves = treedef.flatten_up_to(sharded)
             if key is not None:
                 new_key, *subkeys = jax.random.split(key, len(leaves) + 1)
             else:
                 new_key, subkeys = None, [None] * len(leaves)
-            pairs = [quantized_reduce_scatter(
+            pairs = [(g, e) if sh else quantized_reduce_scatter(
                 g, n, axis, self.reduce_dtype, residual=e, key=k)
-                for g, e, k in zip(leaves, err_leaves, subkeys)]
+                for g, e, k, sh in zip(leaves, err_leaves, subkeys,
+                                       sh_leaves)]
             g_chunks = treedef.unflatten([c / n for c, _ in pairs])
             stepped_err = treedef.unflatten([e for _, e in pairs])
             new_residual = {"err": stepped_err}
@@ -494,9 +533,13 @@ class MixedPrecisionOptimizer:
                 new_residual["key"] = new_key
         else:
             # the scatter IS the data-axis gradient reduction; /n is the
-            # same averaging factor allreduce_gradients applies
+            # same averaging factor allreduce_gradients applies. Sharded
+            # (expert) leaves skip the scatter — their grad is already
+            # this rank's complete shard — but keep the averaging factor
+            # (the allreduce_gradients_by_spec convention).
             g_chunks = jax.tree.map(
-                lambda g: scatter_chunk(g, n, axis) / n, grads32)
+                lambda g, sh: (g if sh else scatter_chunk(g, n, axis)) / n,
+                grads32, sharded)
 
         updates, stepped_inner = self.inner.update(
             g_chunks, state.inner, state.master, **update_kwargs)
@@ -511,11 +554,14 @@ class MixedPrecisionOptimizer:
                 err=keep(new_residual["err"], state.residual["err"]))
 
         # all-gather the updated params; with gather_dtype the payload is
-        # compressed on the wire, then stored back in each param's dtype
+        # compressed on the wire, then stored back in each param's dtype.
+        # Sharded (expert) leaves never gather: the stepped local master
+        # IS the new local shard — just the dtype copy-out.
         new_model = jax.tree.map(
-            lambda c, p: gather_leaf(c, p.shape, p.dtype, axis,
-                                     gather_dtype=self.gather_dtype),
-            new_master, model_params)
+            lambda c, p, sh: (c.astype(p.dtype) if sh else
+                              gather_leaf(c, p.shape, p.dtype, axis,
+                                          gather_dtype=self.gather_dtype)),
+            new_master, model_params, sharded)
 
         new_scaler = state.scaler.update(found_inf)
         metrics = {
@@ -610,25 +656,33 @@ class MixedPrecisionOptimizer:
                     f"param_specs tree has {len(spec_leaves)} specs for "
                     f"{len(leaves)} params")
 
-        def chunk_struct(p, spec):
+        def leaf_struct(p, spec):
+            """(state struct, sharded-over-zero-axis) for one param: the
+            1-D fp32 chunk for zero-axis-REPLICATED leaves, the fp32
+            LOCAL shard for zero-axis-sharded (expert) leaves — Xu et
+            al.'s weight-update sharding applied per parameter group."""
             shape = list(p.shape)
+            over_zero = False
             for d, entry in enumerate(spec or ()):
-                if entry is None:
-                    continue
-                axes = entry if isinstance(entry, (tuple, list)) else (entry,)
-                for ax in axes:
+                for ax in _spec_axis_names(entry):
                     if ax == self.zero_axis:
-                        raise ValueError(
-                            f"param of shape {tuple(p.shape)} is SHARDED over "
-                            f"the zero axis {self.zero_axis!r} — ZeRO chunks "
-                            f"require every param replicated over it (dense "
-                            f"models; reduce MoE-style data-sharded groups "
-                            f"separately)")
+                        over_zero = True
                     shape[d] //= mesh.shape[ax]
+            if over_zero:
+                if len(shape) < 2:
+                    raise ValueError(
+                        f"param of shape {tuple(p.shape)} is sharded over "
+                        f"the zero axis {self.zero_axis!r} with a 1-D "
+                        f"local shard: the sharded-state specs classify "
+                        f"1-D leaves as chunks, so rank-1 expert leaves "
+                        f"are unsupported — stack them (E, 1) or keep "
+                        f"them replicated")
+                return jax.ShapeDtypeStruct(tuple(shape), jnp.float32), True
             size = 1
             for s in shape:
                 size *= s
-            return jax.ShapeDtypeStruct((chunk_size(size, n),), jnp.float32)
+            return (jax.ShapeDtypeStruct((chunk_size(size, n),),
+                                         jnp.float32), False)
 
         def sharded_axes(spec):
             out = []
@@ -643,17 +697,34 @@ class MixedPrecisionOptimizer:
 
         self._zero_norm_axes = treedef.unflatten(
             [sharded_axes(s) for s in spec_leaves])
-        chunks = treedef.unflatten(
-            [chunk_struct(p, s) for p, s in zip(leaves, spec_leaves)])
+        structs, flags = zip(*[leaf_struct(p, s)
+                               for p, s in zip(leaves, spec_leaves)])
+        self._zero_sharded = treedef.unflatten(list(flags))
+        expert_specs: dict = {}
+        for st, sp, fl in zip(structs, spec_leaves, flags):
+            if not fl:
+                continue
+            prev = expert_specs.get(st.shape)
+            if prev is not None and prev != sp:
+                raise ValueError(
+                    f"two zero-axis-sharded params share the local shape "
+                    f"{st.shape} but carry different specs ({prev} vs "
+                    f"{sp}): the shape-keyed sharded-state specs cannot "
+                    f"disambiguate them")
+            expert_specs[st.shape] = sp
+        self._zero_expert_specs = expert_specs
+        chunks = treedef.unflatten(list(structs))
         scaler = _scaler_from_policy(self.policy, **self._scaler_kwargs)
         residual = None
         if self.reduce_dtype is not None:
             # error-feedback state: per-rank flat fp32 leaves in the chunk
             # layout (n chunks concatenated — this rank's send error per
-            # destination), mirroring _init_residual exactly
-            residual = {"err": jax.tree.map(
-                lambda c: jax.ShapeDtypeStruct((c.shape[0] * n,),
-                                               jnp.float32), chunks)}
+            # destination), mirroring _init_residual exactly; sharded
+            # (expert) leaves have no wire and carry an empty leaf
+            residual = {"err": treedef.unflatten([
+                jax.ShapeDtypeStruct((0,) if fl else (st.shape[0] * n,),
+                                     jnp.float32)
+                for st, fl in zip(structs, flags)])}
             if self.stochastic_rounding:
                 residual["key"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
 
@@ -671,10 +742,21 @@ class MixedPrecisionOptimizer:
         ``P(tuple(mesh.axis_names))`` — each device owns exactly its chunk,
         with no replication assumption over ANY axis, so chunks of model-
         and pipe-sharded params round-trip correctly too; scalars (step
-        counters, the loss-scale machine) are replicated."""
+        counters, the loss-scale machine) are replicated. Zero-axis-SHARDED
+        (expert) leaves — whose masters/moments are the fp32 LOCAL shard,
+        rank >= 2 by construction — carry their param's own PartitionSpec,
+        matched by local shape (``zero_abstract_state`` records the
+        table and rejects ambiguous shapes)."""
         from apex_tpu.optimizers.distributed import state_specs as _specs
 
-        return _specs(state, tuple(mesh.axis_names))
+        base = _specs(state, tuple(mesh.axis_names))
+        expert = self._zero_expert_specs
+        if not expert:
+            return base
+        return jax.tree.map(
+            lambda x, sp: expert.get(
+                tuple(getattr(x, "shape", ()) or ()), sp),
+            state, base)
 
     def zero_init(self, model_params, mesh, param_specs):
         """Initialize the sharded state from host-side (global) params.
@@ -719,10 +801,11 @@ class MixedPrecisionOptimizer:
                     if ax == self.zero_axis:
                         raise ValueError(
                             f"param of shape {tuple(p.shape)} is SHARDED "
-                            f"over the zero axis {self.zero_axis!r} — ZeRO "
-                            f"chunks require every param replicated over "
-                            f"it (dense models; reduce MoE-style "
-                            f"data-sharded groups separately)")
+                            f"over the zero axis {self.zero_axis!r} — "
+                            f"zero_level=3 requires every param replicated "
+                            f"over it (expert-axis-sharded MoE params "
+                            f"compose at ZeRO levels 1/2 only: the chunk "
+                            f"drive has no expert-shard gather story)")
                     if mesh is not None:
                         shape[d] //= mesh.shape[ax]
             return tuple(shape)

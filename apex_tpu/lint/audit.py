@@ -17,6 +17,10 @@ CPU mesh:
                        ``value_and_grad``;
 - ``zerobubble``     — the schedule-as-data W/B-split executor
                        (``zero_bubble_grads_fn``) over pp=2 x dp=4;
+- ``moe``            — the expert-parallel MoE grads program (int8
+                       dispatch wire) under ``value_and_grad`` at dp=8,
+                       with the ``moe-dispatch`` tripwire armed
+                       (ISSUE 15);
 - ``serve_prefill``/``serve_decode`` — the serving engine's two
                        shape-stable jitted programs over the paged cache.
 
@@ -272,6 +276,43 @@ def _build_zerobubble():
             mesh_lib.destroy_model_parallel), params
 
 
+def _build_moe():
+    """The expert-parallel MoE grads program (ISSUE 15): value_and_grad
+    of the EP GPT loss on per-shard params under ``axes={"data": 8}``,
+    with the int8 dispatch wire armed — the canonical program the
+    ``moe-dispatch`` tripwire pins (dispatch all_to_alls present, every
+    dispatch-shaped bulk payload at 1 B/elem)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_seq_len=16,
+                    hidden_dropout=0.0, axis=None,
+                    compute_dtype=jnp.bfloat16, remat=True,
+                    moe_num_experts=8, moe_top_k=2,
+                    moe_capacity_factor=2.0, moe_expert_axis="data",
+                    moe_dispatch_dtype="int8")
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    # per-shard (dp=8) param view: one expert per rank (stacked moe
+    # leaves carry the expert dim at axis 1), everything else replicated
+    layers = dict(params["layers"])
+    layers["moe"] = {
+        "router": layers["moe"]["router"],
+        "fc1": jax.tree.map(lambda v: v[:, :1], layers["moe"]["fc1"]),
+        "fc2": jax.tree.map(lambda v: v[:, :1], layers["moe"]["fc2"]),
+    }
+    local = dict(params, layers=layers)
+    toks = jnp.zeros((2, 16), jnp.int32)
+
+    def loss_fn(p):
+        return model.loss(p, toks, toks)
+
+    return jax.value_and_grad(loss_fn), (local,)
+
+
 def _build_serve():
     """The serving engine's two shape-stable jitted programs (prefill,
     decode) on a serial tiny build — the argument streams come from the
@@ -303,7 +344,7 @@ def run_audit(programs: Optional[Iterable[str]] = None,
     from apex_tpu.utils.compat import ensure_jax_compat
 
     ensure_jax_compat()  # jax<0.5: the builders use jax.shard_map
-    known = {"dense", "zero", "zero3_prefetch", "zerobubble",
+    known = {"dense", "zero", "zero3_prefetch", "zerobubble", "moe",
              "serve_prefill", "serve_decode"}
     wanted = set(programs) if programs else None
     if wanted is not None and wanted - known:
@@ -356,6 +397,14 @@ def run_audit(programs: Optional[Iterable[str]] = None,
         record("zerobubble", audit_step_program(
             fn, params, *args, label="zerobubble", options=opts))
         cleanup()
+    if want("moe"):
+        fn, args = _build_moe()
+        record("moe", audit_step_program(
+            fn, *args, label="moe", axes={"data": 8}, options=opts,
+            tripwires=[
+                ("moe-dispatch", lambda ir: lint_trace.moe_dispatch_hazards(
+                    ir, expert_axis="data", wire_dtype="int8")),
+            ]))
     if want("serve_prefill") or want("serve_decode"):
         eng = _build_serve()
         if want("serve_prefill"):
@@ -461,7 +510,7 @@ def main(argv=None) -> int:
                     "programs (one JSON verdict line; exit 0 iff clean)")
     p.add_argument("--programs", type=str, default=None,
                    help="comma-separated subset (dense,zero,"
-                        "zero3_prefetch,zerobubble,serve_prefill,"
+                        "zero3_prefetch,zerobubble,moe,serve_prefill,"
                         "serve_decode)")
     p.add_argument("--hbm-check", action="store_true",
                    help="add the 110M-class static-vs-monitor.hbm "

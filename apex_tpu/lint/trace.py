@@ -56,6 +56,16 @@ on chip (PERF_NOTES.md, CLAUDE.md gotchas):
   quantizes grads with no error-feedback residual leaf in the optimizer
   state -- bias then accumulates instead of telescoping.
 
+- ``moe-dispatch``      (:func:`moe_dispatch_hazards`) -- an expert-
+  parallel MoE step with NO dispatch ``all_to_all`` over the expert axis
+  in its trace (the experts silently run replicated -- dense FLOPs at
+  sparse prices), or a step that requests a quantized dispatch wire
+  (``GPTConfig.moe_dispatch_dtype``) yet ships a dispatch-SHAPED bulk
+  ``all_to_all`` payload at >= 2 bytes/elem. Dispatch payloads are
+  classified by rank (>= 3: the (experts, capacity, hidden) token
+  buckets) so the rank-2 ZeRO grad-chunk all_to_alls sharing the same
+  mesh axis never pollute the verdict.
+
 - ``decode-recompile``  (:func:`decode_recompile_hazards`) -- a serving
   decode step whose jit signature DRIFTS across ticks (growing per-request
   KV shapes, python-int position/tick leaks): one recompile per generated
@@ -768,6 +778,126 @@ def quantized_comm_hazards(fn, *args,
         "census": census,
         "fat_reduces": fat,
         "quantized_reduces": thin,
+        "findings": findings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# MoE dispatch tripwire
+# ---------------------------------------------------------------------------
+
+
+def moe_dispatch_census(jaxpr, expert_axis: str,
+                        min_bulk_elems: int = 1 << 12,
+                        min_dispatch_rank: int = 3) -> Dict[str, Any]:
+    """Census of BULK ``all_to_all`` traffic over ``expert_axis``, split
+    into DISPATCH-shaped payloads (an operand of rank >=
+    ``min_dispatch_rank`` — the (experts, capacity, hidden) token buckets
+    of ``transformer/moe.py``, or their split-block quantized form) and
+    chunk-shaped ones (the rank-2 ZeRO grad rows of
+    ``parallel/quantize.quantized_reduce_scatter``, which legitimately
+    share the same mesh axis), each keyed by the payload's wire itemsize
+    in bytes — an int8-encoded dispatch tallies under ``"1"``, a
+    surviving fp32 bucket under ``"4"``. The tiny fp32 scale
+    side-channels sit below the bulk floor and never pollute the table.
+    Counts are call sites per trace (a dispatch inside ``lax.scan``
+    counts once, like the comm accounting)."""
+    import numpy as np
+
+    dispatch: Dict[str, Counter] = {}
+    chunk: Dict[str, Counter] = {}
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "all_to_all":
+            continue
+        if expert_axis not in _eqn_axis_names(eqn):
+            continue
+        bulk_ops = [v for v in eqn.invars
+                    if _aval_of(v) is not None
+                    and int(getattr(_aval_of(v), "size", 0) or 0)
+                    >= min_bulk_elems]
+        if not bulk_ops:
+            continue
+        itemsize = max(int(np.dtype(_aval_of(v).dtype).itemsize)
+                       for v in bulk_ops)
+        rank = max(len(getattr(_aval_of(v), "shape", ()) or ())
+                   for v in bulk_ops)
+        table = dispatch if rank >= min_dispatch_rank else chunk
+        table.setdefault(str(itemsize), Counter())["all_to_all"] += 1
+    return {"dispatch": {k: dict(v) for k, v in sorted(dispatch.items())},
+            "chunk": {k: dict(v) for k, v in sorted(chunk.items())}}
+
+
+def moe_dispatch_hazards(fn, *args,
+                         expert_axis: str = "data",
+                         axes: Optional[Dict[str, int]] = None,
+                         wire_dtype: Optional[str] = None,
+                         min_bulk_elems: int = 1 << 12,
+                         min_dispatch_rank: int = 3,
+                         **kwargs) -> Dict[str, Any]:
+    """Verify an expert-parallel MoE step actually DISPATCHES its tokens
+    over the expert axis — and, when a quantized dispatch wire was
+    requested, that the buckets move at 1 byte/elem.
+
+    Traces ``fn(*args)`` under ``axes`` (name -> size bindings; omit when
+    ``fn`` binds its own axes via shard_map) and censuses bulk
+    ``all_to_all`` traffic on ``expert_axis``
+    (:func:`moe_dispatch_census`). Two silent regressions this names:
+
+    - **replicated experts**: a step built with ``moe_expert_axis`` whose
+      trace carries NO dispatch-shaped all_to_all — a refactor routed the
+      tokens through the dense one-hot einsums on every rank (serial
+      ``apply`` under shard_map compiles fine and computes E× the FLOPs);
+    - **fat dispatch wire** (``wire_dtype`` given): a dispatch payload at
+      >= 2 bytes/elem where ``moe_dispatch_dtype`` promised the encoded
+      1 B/elem exchange (``parallel/quantize.quantized_all_to_all``).
+
+    Dispatch payloads are rank-classified (>= ``min_dispatch_rank``) so
+    ZeRO's rank-2 grad-chunk all_to_alls on the same axis are reported
+    under ``census["chunk"]`` and never counted — hand the tripwire
+    either the forward loss or the whole train step.
+
+    Returns ``{hazard, census, dispatch_all_to_alls, fat_dispatches,
+    findings}`` — call-site counts per trace, like
+    :func:`zero_redundancy_hazards`.
+    """
+    jaxpr = _ir.trace_ir(fn, *args, axes=axes, **kwargs)
+    census = moe_dispatch_census(
+        jaxpr, expert_axis, min_bulk_elems=min_bulk_elems,
+        min_dispatch_rank=min_dispatch_rank)
+    n_dispatch = sum(n for verbs in census["dispatch"].values()
+                     for n in verbs.values())
+    fat = sum(n for size, verbs in census["dispatch"].items()
+              if int(size) > 1 for n in verbs.values())
+    findings = []
+    if not n_dispatch:
+        findings.append({
+            "rule": "moe-dispatch-missing",
+            "message": (
+                f"step jaxpr carries NO dispatch-shaped all_to_all on the "
+                f"'{expert_axis}' axis in an expert-parallel MoE step -- "
+                f"the experts silently run replicated (every rank computes "
+                f"all E experts' FFNs); route the token buckets through "
+                f"MoEMLP.apply_expert_parallel's all_to_all exchange "
+                f"(transformer/moe.py)"),
+            "verb": "all_to_all", "extra": 0,
+        })
+    if wire_dtype is not None and fat:
+        findings.append({
+            "rule": "moe-dispatch-fat-wire",
+            "message": (
+                f"step jaxpr ships {fat} dispatch-shaped bulk all_to_all "
+                f"payload(s) on the '{expert_axis}' axis at >= 2 "
+                f"bytes/elem in a step that requests a quantized dispatch "
+                f"wire ({wire_dtype}) -- route dispatch/combine through "
+                f"parallel/quantize.quantized_all_to_all so the buckets "
+                f"move 1 B/elem plus the fp32 scale side-channel"),
+            "verb": "all_to_all", "extra": fat,
+        })
+    return {
+        "hazard": bool(findings),
+        "census": census,
+        "dispatch_all_to_alls": n_dispatch,
+        "fat_dispatches": fat,
         "findings": findings,
     }
 

@@ -136,6 +136,14 @@ class GPTConfig:
     moe_expert_axis: Optional[str] = None
     moe_aux_loss_weight: float = 0.01
     moe_z_loss_weight: float = 1e-3
+    # Quantized wire dtype ("int8" | "e5m2") for the expert-parallel
+    # dispatch/combine all_to_all payloads (requires moe_expert_axis when
+    # set; ignored on a serial build — the serial-twin convention of
+    # activation_comm_dtype): token buckets encode to 1 B/elem with fp32
+    # per-destination-block scales riding a tiny side-channel, forward AND
+    # backward (parallel/quantize.quantized_all_to_all). Activations carry
+    # no error-feedback residual. None = exact wire.
+    moe_dispatch_dtype: Optional[str] = None
 
     @property
     def ffn(self) -> int:
@@ -185,6 +193,11 @@ class GPTModel(TransformerBase):
                 tp_axis=c.axis,  # expert FFNs ride the model axis (EP x TP)
                 params_dtype=c.params_dtype,
                 init_method=tp.scaled_normal(c.init_method_std),
+                # serial-twin convention (activation_comm_dtype): a serial
+                # build of an expert-parallel config must run — there is
+                # no dispatch wire to quantize without the expert axis
+                dispatch_dtype=(c.moe_dispatch_dtype
+                                if c.moe_expert_axis is not None else None),
             )
 
     # -- parameters ---------------------------------------------------------
@@ -357,12 +370,14 @@ class GPTModel(TransformerBase):
     # the paged KV cache instead of recomputing the whole context per token.
 
     def check_servable(self) -> None:
-        """Serving composes with TP and attention_window; the modes that
-        reshape the sequence or route tokens (CP rings, Megatron SP, MoE)
-        have no decode-cache story yet — fail loudly at engine build."""
+        """Serving composes with TP, attention_window, and MoE FFNs
+        (serial experts or expert-parallel decode: per-tick top-k routing
+        is data, not shapes, so the decode program stays shape-stable —
+        :meth:`_serve_ffn`); the modes that reshape the sequence (CP
+        rings, Megatron SP) have no decode-cache story yet — fail loudly
+        at engine build. An expert-parallel build (``moe_expert_axis``)
+        additionally needs the mesh at the engine (engine-side check)."""
         c = self.cfg
-        if c.moe_num_experts is not None:
-            raise ValueError("serving does not support MoE FFNs yet")
         if getattr(c, "context_axis", None) is not None:
             raise ValueError(
                 "serving does not support context parallelism: the paged "
@@ -388,6 +403,21 @@ class GPTModel(TransformerBase):
                 h = h + jnp.take(params["position"], positions, axis=0)
             return h.astype(c.compute_dtype)
 
+    def _serve_ffn(self, p: Params, x: jax.Array) -> jax.Array:
+        """The FFN half of a serving layer: the dense MLP, or the routed
+        MoE block at inference (aux losses dropped — nothing trains).
+        Expert-parallel builds dispatch through the token-replicated
+        conjugate (``MoEMLP.apply_expert_sharded``: identical routing on
+        every rank, local-expert compute, one psum combine — the same
+        function as serial ``apply``, so greedy streams match the serial
+        engine's bit for bit)."""
+        c = self.cfg
+        if c.moe_num_experts is None:
+            return self._mlp(p, x)
+        if c.moe_expert_axis is not None:
+            return self.moe.apply_expert_sharded(p["moe"], x)
+        return self.moe.apply(p["moe"], x)[0]
+
     def serve_layers_prefill(self, layers: Params, h: jax.Array):
         """Run the layer stack over a PROMPT, collecting every layer's k/v
         head tensors for the cache fill. Returns ``(h, k, v)`` with k/v
@@ -399,7 +429,7 @@ class GPTModel(TransformerBase):
             x = self._ln(p["ln1"], h)
             q, k, v = self._qkv_heads(p["qkv"], x)
             h = h + self._attn_out(p, self._attend(q, k, v, None))
-            h = h + self._mlp(p, self._ln(p["ln2"], h))
+            h = h + self._serve_ffn(p, self._ln(p["ln2"], h))
             return h, (k, v)
 
         h, (ks, vs) = lax.scan(body, h, layers)
@@ -412,33 +442,34 @@ class GPTModel(TransformerBase):
                             positions: jax.Array):
         """One decode tick through the layer stack: for each layer, write
         the new token's k/v heads into the paged cache (``write_flat``:
-        per-slot flat row index into the ``(num_blocks*block, kv_heads,
-        head_dim)`` view — the engine owns the page arithmetic; idle slots
-        point at the reserved null page), then flash-decode the token's
-        query over the pages. ``h`` is ``(b, 1, hidden)``; the caches are
-        layer-stacked ``(L, num_blocks, block, kv_heads, head_dim)`` and
-        scan ys rebuild them updated. ``attend_lengths`` includes the token
-        just written (0 = idle slot, output exactly 0)."""
+        per-slot flat position index ``block_id * block + offset`` — the
+        engine owns the page arithmetic; idle slots point at the reserved
+        null page), then flash-decode the token's query over the pages.
+        ``h`` is ``(b, 1, hidden)``; the caches are layer-stacked
+        ``(L, num_blocks, kv_heads, block, head_dim)`` (block in the
+        sublane dim — serve/cache.py layout) and scan ys rebuild them
+        updated. ``attend_lengths`` includes the token just written
+        (0 = idle slot, output exactly 0)."""
         from apex_tpu.ops.flash_decode import flash_decode
 
         c = self.cfg
 
         def body(h, xs):
             p, kp, vp = xs
-            n_blocks, blk = kp.shape[0], kp.shape[1]
-            flat_shape = (n_blocks * blk,) + kp.shape[2:]
+            blk = kp.shape[2]
+            bi, off = write_flat // blk, write_flat % blk
             x = self._ln(p["ln1"], h)
             q, k, v = self._qkv_heads(p["qkv"], x,
                                       positions=positions[:, None])
-            kp = kp.reshape(flat_shape).at[write_flat].set(
-                k[:, :, 0, :].astype(kp.dtype)).reshape(kp.shape)
-            vp = vp.reshape(flat_shape).at[write_flat].set(
-                v[:, :, 0, :].astype(vp.dtype)).reshape(vp.shape)
+            # advanced indices split by the head slice land in front:
+            # kp[bi, :, off] is (b, kv_heads, d), matching the new heads
+            kp = kp.at[bi, :, off].set(k[:, :, 0, :].astype(kp.dtype))
+            vp = vp.at[bi, :, off].set(v[:, :, 0, :].astype(vp.dtype))
             attn = flash_decode(
                 q[:, :, 0, :], kp, vp, block_tables, attend_lengths,
                 window=c.attention_window, impl=c.attention_impl)
             h = h + self._attn_out(p, attn[:, :, None, :])
-            h = h + self._mlp(p, self._ln(p["ln2"], h))
+            h = h + self._serve_ffn(p, self._ln(p["ln2"], h))
             return h, (kp, vp)
 
         h, (kps, vps) = lax.scan(body, h, (layers, k_pages, v_pages))
@@ -451,8 +482,8 @@ class GPTModel(TransformerBase):
                            positions: jax.Array):
         """K-token sibling of :meth:`serve_layers_decode`: per layer, write
         K new tokens' k/v heads per slot into the paged cache (``write_flat``
-        ``(b, K)`` flat row indices; masked rows point at the null page),
-        then K-query flash-decode over the pages with TRAILING-query
+        ``(b, K)`` flat position indices; masked rows point at the null
+        page), then K-query flash-decode over the pages with TRAILING-query
         semantics (``attend_lengths[b]`` = keys visible to the FINAL query;
         query ``j`` sees ``attend_lengths[b] - (K-1-j)`` — in-chunk
         causality by length arithmetic). ``h`` is ``(b, K, hidden)``,
@@ -465,20 +496,21 @@ class GPTModel(TransformerBase):
 
         def body(h, xs):
             p, kp, vp = xs
-            n_blocks, blk = kp.shape[0], kp.shape[1]
-            flat_shape = (n_blocks * blk,) + kp.shape[2:]
+            blk = kp.shape[2]
+            bi, off = write_flat // blk, write_flat % blk
             x = self._ln(p["ln1"], h)
             q, k, v = self._qkv_heads(p["qkv"], x, positions=positions)
-            # (b, nh, K, d) -> (b, K, nh, d): page rows are (head, dim)
-            kp = kp.reshape(flat_shape).at[write_flat].set(
-                k.transpose(0, 2, 1, 3).astype(kp.dtype)).reshape(kp.shape)
-            vp = vp.reshape(flat_shape).at[write_flat].set(
-                v.transpose(0, 2, 1, 3).astype(vp.dtype)).reshape(vp.shape)
+            # (b, nh, K, d) -> (b, K, nh, d): kp[bi, :, off] is
+            # (b, K, kv_heads, d) with the (b, K) advanced indices in front
+            kp = kp.at[bi, :, off].set(
+                k.transpose(0, 2, 1, 3).astype(kp.dtype))
+            vp = vp.at[bi, :, off].set(
+                v.transpose(0, 2, 1, 3).astype(vp.dtype))
             attn = flash_decode_multi(
                 q, kp, vp, block_tables, attend_lengths,
                 window=c.attention_window, impl=c.attention_impl)
             h = h + self._attn_out(p, attn)
-            h = h + self._mlp(p, self._ln(p["ln2"], h))
+            h = h + self._serve_ffn(p, self._ln(p["ln2"], h))
             return h, (kp, vp)
 
         h, (kps, vps) = lax.scan(body, h, (layers, k_pages, v_pages))

@@ -407,6 +407,9 @@ def _check_lint() -> dict:
 
     from apex_tpu import lint
     from apex_tpu.lint import trace as lint_trace
+    from apex_tpu.utils.compat import ensure_jax_compat
+
+    ensure_jax_compat()  # jax<0.5: the MoE dispatch fixture uses axis_size
 
     # engine 1: the tree itself must lint clean, with every suppression
     # carrying a justification (the same contract tests/test_lint.py
@@ -550,6 +553,48 @@ def _check_lint() -> dict:
         qc_good, big, axes={"data": 8}, residual=None)
     assert qc_nores["hazard"] and qc_nores["findings"][0][
         "rule"] == "quantized-comm-no-residual", qc_nores
+
+    # engine 2, MoE dispatch tripwire (ISSUE 15): an expert-parallel MoE
+    # layer's all_to_all dispatch passes (and its int8 wire passes the
+    # fat-wire check); a replicated-expert run of the SAME layer under an
+    # expert-parallel request is flagged, as is an fp32 dispatch under a
+    # quantized-wire request. The rank-2 ZeRO grad all_to_alls on the
+    # same axis never pollute the dispatch census.
+    from apex_tpu.transformer.moe import MoEMLP
+
+    moe = MoEMLP(8, 16, num_experts=8, top_k=2, capacity_factor=2.0,
+                 expert_axis="data")
+    moe_q = MoEMLP(8, 16, num_experts=8, top_k=2, capacity_factor=2.0,
+                   expert_axis="data", dispatch_dtype="int8")
+    mp = moe.init(_jax.random.PRNGKey(0))
+    mp_local = {"router": mp["router"],
+                "fc1": _jax.tree.map(lambda v: v[:1], mp["fc1"]),
+                "fc2": _jax.tree.map(lambda v: v[:1], mp["fc2"])}
+    # 256 tokens -> (E=8, C=128, d=8) buckets: 8192 elems, over the bulk
+    # floor (a smaller batch's dispatch would be filtered as side-channel)
+    xtok = jnp.ones((256, 8), jnp.float32)
+    md_ok = lint_trace.moe_dispatch_hazards(
+        moe.apply_expert_parallel, mp_local, xtok, axes={"data": 8})
+    assert not md_ok["hazard"] and md_ok["dispatch_all_to_alls"] == 2, md_ok
+    md_bad = lint_trace.moe_dispatch_hazards(
+        moe.apply, mp, xtok, axes={"data": 8})
+    assert md_bad["hazard"] and md_bad["findings"][0][
+        "rule"] == "moe-dispatch-missing", md_bad
+    md_fat = lint_trace.moe_dispatch_hazards(
+        moe.apply_expert_parallel, mp_local, xtok, axes={"data": 8},
+        wire_dtype="int8")
+    assert md_fat["hazard"] and md_fat["findings"][0][
+        "rule"] == "moe-dispatch-fat-wire", md_fat
+    md_q = lint_trace.moe_dispatch_hazards(
+        moe_q.apply_expert_parallel, mp_local, xtok, axes={"data": 8},
+        wire_dtype="int8")
+    assert not md_q["hazard"] and md_q["dispatch_all_to_alls"] == 2, md_q
+    # the quantized ZeRO grad reduce's rank-2 all_to_alls land in the
+    # chunk bucket, not the dispatch census
+    md_chunk = lint_trace.moe_dispatch_hazards(
+        qc_good, big, axes={"data": 8})
+    assert md_chunk["census"]["chunk"] and not md_chunk[
+        "census"]["dispatch"], md_chunk
 
     # engine 2, sequence-parallel tripwire: an activation psum on the TP
     # axis is the regression; the reduce_scatter/all_gather conjugates and

@@ -16,11 +16,14 @@ Reference: the fused single-pass attention of apex/contrib/fmha/fmha.py:33-74
 and the decode grid are beyond-reference capability, per the operation-fusion
 framing of PAPERS.md (LLM inference acceleration via op fusion).
 
-Layouts (the T(8,128) reasoning, PERF_NOTES r11): pages are
-``(num_blocks, block, kv_heads, head_dim)`` with head_dim MINOR — the lane
-dim is head_dim (full vregs at d >= 128, the same 4x-pad-at-d-32 tax as
-training) and the sublane dim inside a kernel block is the block size
-(multiple of 8), so a page never pays the 128x ``(.., 1)`` column tax the
+Layouts (the T(8,128) reasoning, PERF_NOTES r11 + the ISSUE 13 static-hbm
+catch): pages are ``(num_blocks, kv_heads, block, head_dim)`` with head_dim
+MINOR — the lane dim is head_dim (full vregs at d >= 128, the same
+4x-pad-at-d-32 tax as training) — and the BLOCK SIZE second-minor, so the
+sublane dim is a multiple of 8 by construction and the pool's padded
+residency is the head_dim padding alone (the earlier kv_heads-second-minor
+order padded 4 heads to 8 sublanes: 4x total at f32/h4/d64, static-hbm's
+first real catch); a page never pays the 128x ``(.., 1)`` column tax the
 lse tables were redesigned to avoid.
 
 GQA-style head broadcasting: ``q`` carries ``H`` query heads over ``KH``
@@ -70,12 +73,15 @@ def paged_attention_reference(
     gather the pages dense, mask by length/window, one-pass softmax. The
     oracle the kernel is tested against, and the off-TPU default."""
     b, h, d = q.shape
-    _, blk, kh, _ = k_pages.shape
+    _, kh, blk, _ = k_pages.shape
     g = h // kh
     scale = (d ** -0.5) if scale is None else float(scale)
     s_max = block_tables.shape[1] * blk
-    k = k_pages[block_tables].reshape(b, s_max, kh, d)
-    v = v_pages[block_tables].reshape(b, s_max, kh, d)
+    # (b, nb, kh, blk, d) -> (b, s_max, kh, d): positions contiguous
+    k = k_pages[block_tables].transpose(0, 1, 3, 2, 4).reshape(
+        b, s_max, kh, d)
+    v = v_pages[block_tables].transpose(0, 1, 3, 2, 4).reshape(
+        b, s_max, kh, d)
     qg = q.reshape(b, kh, g, d).astype(jnp.float32)
     s = jnp.einsum("bkgd,bskd->bkgs", qg, k.astype(jnp.float32)) * scale
     pos = jnp.arange(s_max, dtype=jnp.int32)
@@ -107,12 +113,14 @@ def paged_attention_multi_reference(
     ``q`` is ``(batch, heads, K, head_dim)``; query ``j`` sees
     ``lengths[b] - (K - 1 - j)`` keys."""
     b, h, kq, d = q.shape
-    _, blk, kh, _ = k_pages.shape
+    _, kh, blk, _ = k_pages.shape
     g = h // kh
     scale = (d ** -0.5) if scale is None else float(scale)
     s_max = block_tables.shape[1] * blk
-    k = k_pages[block_tables].reshape(b, s_max, kh, d)
-    v = v_pages[block_tables].reshape(b, s_max, kh, d)
+    k = k_pages[block_tables].transpose(0, 1, 3, 2, 4).reshape(
+        b, s_max, kh, d)
+    v = v_pages[block_tables].transpose(0, 1, 3, 2, 4).reshape(
+        b, s_max, kh, d)
     qg = q.reshape(b, kh, g, kq, d).astype(jnp.float32)
     s = jnp.einsum("bkgqd,bskd->bkgqs", qg,
                    k.astype(jnp.float32)) * scale
@@ -143,8 +151,8 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     length = len_ref[b]
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (G, D)
-    k = k_ref[0, :, 0, :].astype(jnp.float32)    # (blk, D)
-    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)          # (blk, D)
+    v = v_ref[0, 0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # (G, blk)
@@ -191,9 +199,10 @@ def flash_decode(
       q: ``(batch, heads, head_dim)`` — one query per sequence slot (the
         token being decoded, already written to the cache so it attends
         itself; ``lengths`` includes it).
-      k_pages, v_pages: ``(num_blocks, block, kv_heads, head_dim)`` page
-        pools (apex_tpu.serve.cache layout; ``heads % kv_heads == 0``,
-        query-head groups broadcast over each kv head — GQA).
+      k_pages, v_pages: ``(num_blocks, kv_heads, block, head_dim)`` page
+        pools (apex_tpu.serve.cache layout: block in the sublane dim;
+        ``heads % kv_heads == 0``, query-head groups broadcast over each
+        kv head — GQA).
       block_tables: ``(batch, max_blocks)`` int32 — page ids per sequence,
         position ``p`` living in table slot ``p // block`` at offset
         ``p % block``. Slots beyond a sequence's allocation must point at
@@ -212,7 +221,7 @@ def flash_decode(
     Returns ``(batch, heads, head_dim)`` in ``q.dtype``.
     """
     b, h, d = q.shape
-    n_pages, blk, kh, d2 = k_pages.shape
+    n_pages, kh, blk, d2 = k_pages.shape
     if d2 != d or v_pages.shape != k_pages.shape:
         raise ValueError(
             f"page shapes {k_pages.shape}/{v_pages.shape} do not match "
@@ -243,10 +252,11 @@ def flash_decode(
             pl.BlockSpec((1, 1, g, d), lambda bi, ki, j, tbl, ln: (bi, ki, 0, 0)),
             # the paged fetch: the PAGE index comes from the prefetched
             # block table, so the same compiled program walks any table
-            pl.BlockSpec((1, blk, 1, d),
-                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], 0, ki, 0)),
-            pl.BlockSpec((1, blk, 1, d),
-                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], 0, ki, 0)),
+            # (page rows are (block, head_dim) — block in the sublane dim)
+            pl.BlockSpec((1, 1, blk, d),
+                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], ki, 0, 0)),
+            pl.BlockSpec((1, 1, blk, d),
+                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], ki, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, g, d),
                                lambda bi, ki, j, tbl, ln: (bi, ki, 0, 0)),
@@ -287,8 +297,8 @@ def _decode_multi_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     length = len_ref[b]
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (G*K, D)
-    k = k_ref[0, :, 0, :].astype(jnp.float32)    # (blk, D)
-    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)          # (blk, D)
+    v = v_ref[0, 0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # (G*K, blk)
@@ -346,7 +356,7 @@ def flash_decode_multi(
     Returns ``(batch, heads, K, head_dim)`` in ``q.dtype``.
     """
     b, h, kq, d = q.shape
-    n_pages, blk, kh, d2 = k_pages.shape
+    n_pages, kh, blk, d2 = k_pages.shape
     if d2 != d or v_pages.shape != k_pages.shape:
         raise ValueError(
             f"page shapes {k_pages.shape}/{v_pages.shape} do not match "
@@ -384,10 +394,10 @@ def flash_decode_multi(
         in_specs=[
             pl.BlockSpec((1, 1, g * kq, d),
                          lambda bi, ki, j, tbl, ln: (bi, ki, 0, 0)),
-            pl.BlockSpec((1, blk, 1, d),
-                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], 0, ki, 0)),
-            pl.BlockSpec((1, blk, 1, d),
-                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], 0, ki, 0)),
+            pl.BlockSpec((1, 1, blk, d),
+                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], ki, 0, 0)),
+            pl.BlockSpec((1, 1, blk, d),
+                         lambda bi, ki, j, tbl, ln: (tbl[bi, j], ki, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, g * kq, d),
                                lambda bi, ki, j, tbl, ln: (bi, ki, 0, 0)),

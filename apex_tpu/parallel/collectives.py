@@ -62,7 +62,8 @@ COMM_SCOPE_HELPERS = ("_comm", "collective_scope",
                       "quantized_reduce_scatter",
                       "quantized_psum_scatter",
                       "quantized_all_gather",
-                      "quantized_gather_chunk")
+                      "quantized_gather_chunk",
+                      "quantized_all_to_all")
 
 # The jaxpr-level decomposition contract of sequence parallelism (read
 # statically by apex_tpu.lint.trace.sequence_parallel_hazards, like the
@@ -90,6 +91,18 @@ ZERO_DECOMPOSED_PRIMS = ("reduce_scatter", "all_gather")
 # quantization silently regressed to the 4 B/elem wire.
 QUANTIZED_WIRE_ITEMSIZE = 1
 QUANTIZED_REDUCE_PRIMS = ("reduce_scatter", "all_to_all")
+
+# The expert-parallel dispatch contract (apex_tpu.lint.trace.
+# moe_dispatch_hazards, read statically like the sets above): a step that
+# requests expert parallelism (``GPTConfig.moe_expert_axis``) must move
+# its token buckets as ``all_to_all`` over the expert axis — a trace with
+# no dispatch all_to_all means the experts silently run replicated; and
+# under ``moe_dispatch_dtype`` the DISPATCH-SHAPED payloads (rank >=
+# MOE_DISPATCH_MIN_RANK — the (experts, capacity, hidden) buckets, vs the
+# rank-2 ZeRO grad-chunk rows that may share the same mesh axis) must
+# move at the 1-byte wire dtype (parallel/quantize.quantized_all_to_all).
+MOE_DISPATCH_PRIMS = ("all_to_all",)
+MOE_DISPATCH_MIN_RANK = 3
 
 #: every verb in this module must run under a ``comm:`` scope; the marker
 #: opts the file into the lint rule even if the import shape changes

@@ -39,6 +39,7 @@ of (not a substitute for) the residual.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -233,6 +234,79 @@ def quantized_all_gather(x: jax.Array, axis: str, wire_dtype: str,
     dec = (qb.astype(jnp.float32)
            * s_full.reshape((n,) + (1,) * (qb.ndim - 1)))
     return _merge_blocks(dec, gather_dim).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# expert-dispatch conjugate (the MoE all_to_all, transformer/moe.py)
+# ---------------------------------------------------------------------------
+
+
+def _a2a_encoded(x: jax.Array, n: int, axis: str, wire_dtype: str,
+                 split_axis: int, concat_axis: int) -> jax.Array:
+    """The shared encoded-exchange body: split ``x`` into one block per
+    destination rank, encode each at its own fp32 scale, ship blocks +
+    scale side-channel with ``all_to_all``, decode each received block at
+    ITS SENDER's scale, and merge along ``concat_axis``. Output shape and
+    placement match ``lax.all_to_all(tiled=True)`` exactly; only the wire
+    payload is lossy (bounded by the per-destination-block scale)."""
+    xb = _split_blocks(x.astype(jnp.float32), n, split_axis)  # (n, ...)
+    flat = xb.reshape(n, -1)
+    scales = block_scales(flat, wire_dtype)
+    q = encode(flat, scales, wire_dtype).reshape(xb.shape)
+    with _comm("all_to_all", axis, q):
+        q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+    with _comm("all_to_all", axis, scales):
+        s_recv = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+    dec = (q_recv.astype(jnp.float32)
+           * s_recv.reshape((n,) + (1,) * (q_recv.ndim - 1)))
+    return _merge_blocks(dec, concat_axis).astype(x.dtype)
+
+
+def quantized_all_to_all(x: jax.Array, axis: str, wire_dtype: str, *,
+                         split_axis: int, concat_axis: int) -> jax.Array:
+    """``lax.all_to_all(split_axis=, concat_axis=, tiled=True)`` at a
+    1-byte wire dtype — the MoE token dispatch/combine exchange
+    (``transformer/moe.py apply_expert_parallel``) quantized like the SP
+    activation conjugates: per-destination-shard fp32 scales ride a tiny
+    side-channel ``all_to_all`` and the decode happens at the receiver, so
+    each expert sees its tokens at their sender's scale. Stateless — the
+    dispatched activations are fresh every step, so per-block scales alone
+    bound the error and no EF residual is carried (module docstring).
+
+    Differentiable: the backward ships the cotangent through the SAME
+    encoded exchange with split/concat swapped (``lax.all_to_all``'s own
+    transpose), re-quantized at the cotangent's per-block scales — the
+    combine's backward is the dispatch wire and vice versa, so a training
+    step moves 1 B/elem in BOTH directions. Like the SP conjugates, the
+    custom-VJP backward composes with shard_map but not vmap-of-grad
+    (jax's batched tiled all_to_all limitation) — test through shard_map.
+    """
+    return _qa2a(x, axis, wire_dtype, split_axis, concat_axis)
+
+
+def _qa2a_impl(x, axis, wire_dtype, split_axis, concat_axis):
+    return _a2a_encoded(x, lax.axis_size(axis), axis, wire_dtype,
+                        split_axis, concat_axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _qa2a(x, axis, wire_dtype, split_axis, concat_axis):
+    return _qa2a_impl(x, axis, wire_dtype, split_axis, concat_axis)
+
+
+def _qa2a_fwd(x, axis, wire_dtype, split_axis, concat_axis):
+    return _qa2a_impl(x, axis, wire_dtype, split_axis, concat_axis), None
+
+
+def _qa2a_bwd(axis, wire_dtype, split_axis, concat_axis, _, g):
+    # the transpose of all_to_all(split=s, concat=c) is
+    # all_to_all(split=c, concat=s); quantize the cotangent the same way
+    return (_qa2a_impl(g, axis, wire_dtype, concat_axis, split_axis),)
+
+
+_qa2a.defvjp(_qa2a_fwd, _qa2a_bwd)
 
 
 def quantized_gather_chunk(chunk: jax.Array, axis: str, wire_dtype: str,

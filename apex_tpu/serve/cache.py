@@ -7,19 +7,25 @@ per-sequence block tables) rebuilt TPU-native for the serve engine.
 Why pages (the decode-recompile gotcha, CLAUDE.md): a per-request contiguous
 KV buffer either grows with the sequence (a fresh jit signature — and a full
 recompile — per token) or preallocates ``max_seq`` per request (O(max_batch ·
-max_seq) HBM held even for short prompts). A fixed pool of ``(block, kv_heads,
-head_dim)`` pages addressed through an int32 block table keeps every decode
-tick's signature identical and bounds HBM by TOTAL tokens resident, not by
-worst-case per-request length.
+max_seq) HBM held even for short prompts). A fixed pool of ``(kv_heads,
+block, head_dim)`` pages addressed through an int32 block table keeps every
+decode tick's signature identical and bounds HBM by TOTAL tokens resident,
+not by worst-case per-request length.
 
-Layout (the T(8,128) reasoning, PERF_NOTES r11): pages put ``head_dim``
-MINOR — the 128-lane vreg dim — and the block size second-minor (a multiple
-of 8 sublanes), so a page tiles exactly like the training kernels' operands:
-d=128 pages are pad-free, d=32 pays the same 4x lane tax training already
-pays, and nothing ever takes the 128x ``(.., 1)`` column tax. The pool is
-layer-stacked ``(L, num_blocks, block, kv_heads, head_dim)`` with ONE block
-table shared by all layers (block ids are allocated per sequence range, each
-layer storing its own pages at the same ids).
+Layout (the T(8,128) reasoning, PERF_NOTES r11 + the ISSUE 13 static-hbm
+catch): pages put ``head_dim`` MINOR — the 128-lane vreg dim — and the
+BLOCK SIZE second-minor (a multiple of 8 sublanes by construction, enforced
+below), so a page tiles exactly like the training kernels' operands with NO
+sublane pad at any head count: d=128 pages are pad-free, d=32 pays the same
+4x lane tax training already pays, and nothing ever takes the 128x
+``(.., 1)`` column tax. The kv-head dim sits OUTSIDE the tiled minor pair —
+the pre-ISSUE-15 ``(.., block, kv_heads, head_dim)`` order put kv_heads in
+the sublane dim, where 4 heads padded to 8 sublanes and the biggest serving
+tensor paid 4x padded residency at f32/h4/d64 (static-hbm's first real
+catch). The pool is layer-stacked ``(L, num_blocks, kv_heads, block,
+head_dim)`` with ONE block table shared by all layers (block ids are
+allocated per sequence range, each layer storing its own pages at the same
+ids).
 
 Block 0 is the reserved NULL page: idle slots and masked scatter lanes write
 there, and table slots beyond a sequence's allocation point there so the
@@ -342,8 +348,11 @@ class KVCacheConfig:
 
     @property
     def page_shape(self):
-        return (self.num_layers, self.num_blocks, self.block_size,
-                self.kv_heads, self.head_dim)
+        # block in the SUBLANE dim (multiple of 8 by __post_init__),
+        # head_dim in the lane dim, kv_heads outside the tiled pair —
+        # the padded residency is then head_dim padding alone
+        return (self.num_layers, self.num_blocks, self.kv_heads,
+                self.block_size, self.head_dim)
 
     def max_blocks_per_seq(self, max_seq: int) -> int:
         return blocks_for(max_seq, self.block_size)
@@ -360,8 +369,8 @@ def init_kv_cache(cfg: KVCacheConfig, dtype=None):
 
 def kv_cache_spec(axis: Optional[str]):
     """PartitionSpec of a layer-stacked page pool: kv heads shard over the
-    TP axis (dim 3), everything else replicated — the serving twin of the
+    TP axis (dim 2), everything else replicated — the serving twin of the
     training head-sharding contract (a TP rank owns whole heads)."""
     from jax.sharding import PartitionSpec as P
 
-    return P(None, None, None, axis, None)
+    return P(None, None, axis, None, None)

@@ -159,10 +159,16 @@ class Engine:
         self.config = cfg = config.resolved()
         self.mesh = mesh
         self.axis = c.axis
-        if self.axis is not None and mesh is None:
+        # expert-parallel decode (ISSUE 15): an expert-axis-sharded MoE
+        # model runs inside the same shard_map — per-tick routing is data,
+        # not shapes (GPTModel._serve_ffn / MoEMLP.apply_expert_sharded)
+        self.expert_axis = getattr(c, "moe_expert_axis", None)
+        if (self.axis is not None or self.expert_axis is not None) \
+                and mesh is None:
             raise ValueError(
-                "a TP-sharded model (cfg.axis set) needs the mesh — pass "
-                "mesh=, or build the serve model with axis=None")
+                "a sharded model (cfg.axis or cfg.moe_expert_axis set) "
+                "needs the mesh — pass mesh=, or build the serve model "
+                "serial (axis=None, moe_expert_axis=None)")
         if cfg.max_seq > c.max_seq_len:
             raise ValueError(
                 f"max_seq ({cfg.max_seq}) exceeds the model's max_seq_len "
@@ -320,18 +326,20 @@ class Engine:
             pos = jnp.arange(pf, dtype=jnp.int32)
             h = model.embed_at(p, prompt, pos[None])
             h, ks, vs = model.serve_layers_prefill(p["layers"], h)
-            # (L, 1, nh, P, d) -> (L, P, nh, d): page rows are (head, dim)
-            ks = ks[:, 0].transpose(0, 2, 1, 3)
-            vs = vs[:, 0].transpose(0, 2, 1, 3)
-            blk = kp.shape[2]
+            # (L, 1, nh, P, d) -> (P, L, nh, d): the per-position write
+            # rows, (b, K)-advanced-indexed into the (L, nb, kh, blk, d)
+            # pool below (serve/cache.py layout: block in the sublane dim)
+            ks = ks[:, 0].transpose(2, 0, 1, 3)
+            vs = vs[:, 0].transpose(2, 0, 1, 3)
+            blk = kp.shape[3]
             flat = table_row[pos // blk] * blk + pos % blk
             # padding rows land in the null page (never read)
             flat = jnp.where(pos < prompt_len, flat, NULL_BLOCK)
-            pool = (kp.shape[0], kp.shape[1] * blk) + kp.shape[3:]
-            kp = kp.reshape(pool).at[:, flat].set(
-                ks.astype(kp.dtype)).reshape(kp.shape)
-            vp = vp.reshape(pool).at[:, flat].set(
-                vs.astype(vp.dtype)).reshape(vp.shape)
+            bi, off = flat // blk, flat % blk
+            # kp[:, bi, :, off] is (P, L, kh, d): advanced indices split
+            # by slices move to the front
+            kp = kp.at[:, bi, :, off].set(ks.astype(kp.dtype))
+            vp = vp.at[:, bi, :, off].set(vs.astype(vp.dtype))
             h_last = lax.dynamic_slice_in_dim(h, prompt_len - 1, 1, axis=1)
             logits = model.serve_head(p, h_last)[:, 0]  # (1, vocab)
             tok = sample_tokens(logits, fold_tick(key[None], tick),
@@ -339,7 +347,7 @@ class Engine:
             return kp, vp, tok[0]
 
         def decode(p, kp, vp, tables, lengths, tokens, active, keys, tick):
-            blk = kp.shape[2]
+            blk = kp.shape[3]
             pos = lengths  # the new token's position (cache holds [0, pos))
             blk_ids = jnp.take_along_axis(
                 tables, (pos // blk)[:, None], axis=1)[:, 0]
@@ -354,7 +362,7 @@ class Engine:
                                 temperature=temperature, top_k=top_k)
             return kp, vp, jnp.where(active, tok, 0)
 
-        if self.axis is None:
+        if self.mesh is None:
             return jax.jit(prefill), jax.jit(decode)
         specs = self.model.specs()
         cspec = kv_cache_spec(self.axis)
@@ -390,7 +398,7 @@ class Engine:
             valid = ci >= (C - n_valid)
             pos_c = jnp.clip(pos, 0, max_pos)
             h = smodel.embed_at(p, tokens, pos_c[None])
-            blk = kp.shape[2]
+            blk = kp.shape[3]
             flat = table_row[pos_c // blk] * blk + pos_c % blk
             write_flat = jnp.where(valid, flat, NULL_BLOCK)
             attend = (start + n_valid)[None]
@@ -404,7 +412,7 @@ class Engine:
                                 temperature=temperature, top_k=top_k)
             return kp, vp, tok[0]
 
-        if self.axis is None:
+        if self.mesh is None:
             return jax.jit(chunk)
         specs = smodel.specs()
         cspec = kv_cache_spec(self.axis)
@@ -435,7 +443,7 @@ class Engine:
         max_pos_d = dmodel.cfg.max_seq_len - 1
 
         def propose(p, kp, vp, tables, lengths, t0, active, caps):
-            blk = kp.shape[2]
+            blk = kp.shape[3]
 
             def step(carry, i):
                 kp, vp, tok = carry
@@ -461,7 +469,7 @@ class Engine:
             return kp, vp, fed.T  # (B, K): [t0, d1, .., d_{K-1}]
 
         def verify(p, kp, vp, tables, lengths, xs, active, caps):
-            blk = kp.shape[2]
+            blk = kp.shape[3]
             j = jnp.arange(K, dtype=jnp.int32)
             pos = lengths[:, None] + j[None, :]  # (B, K)
             bi = jnp.clip(pos // blk, 0, nb_seq - 1)
@@ -478,7 +486,7 @@ class Engine:
             y = jnp.argmax(logits, -1).astype(jnp.int32)
             return kp, vp, jnp.where(active[:, None], y, 0)
 
-        if self.axis is None:
+        if self.mesh is None:
             return jax.jit(propose), jax.jit(verify)
         cspec = kv_cache_spec(self.axis)
         r = P()

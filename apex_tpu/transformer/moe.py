@@ -39,9 +39,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.monitor.comms import collective_scope as _comm
 from apex_tpu.transformer import tensor_parallel as tp
 
 Params = Dict[str, Any]
+
+#: every collective verb in this module runs under a ``comm:`` scope (the
+#: lint comm-scope rule) so CommAccount books dispatch bytes per (verb,
+#: axis, wire dtype) — the marker opts the file in even if imports change
+LINT_COMM_SCOPE = True
 
 
 def _pmean_value_local_grad(v: jax.Array, axis: str) -> jax.Array:
@@ -53,7 +59,8 @@ def _pmean_value_local_grad(v: jax.Array, axis: str) -> jax.Array:
     grads) recovers the full-batch gradient. Keeps the collective itself
     out of the backward graph (its transpose over-counts under
     ``check_vma=False``)."""
-    bar = lax.pmean(lax.stop_gradient(v), axis)
+    with _comm("pmean", axis, v):
+        bar = lax.pmean(lax.stop_gradient(v), axis)
     return v + (bar - lax.stop_gradient(v))
 
 
@@ -73,6 +80,13 @@ class MoEMLP:
         EP × TP for GPT-3-scale ffn widths.
       params_dtype: parameter dtype (router stays fp32 — routing logits
         are precision-sensitive, like vocab logits).
+      dispatch_dtype: quantized wire dtype ("int8" | "e5m2") for the
+        dispatch/combine ``all_to_all`` payloads — the encoded exchange of
+        ``parallel/quantize.quantized_all_to_all``: 1 B/elem + a tiny fp32
+        per-destination-block scale side-channel, backward re-quantized
+        through the transposed exchange. No EF residual (activations are
+        fresh every step — the quantize.py activation convention).
+        ``None`` = exact wire (traces bit-identical to pre-knob).
     """
 
     def __init__(
@@ -86,6 +100,7 @@ class MoEMLP:
         tp_axis: Optional[str] = None,
         params_dtype: Any = jnp.float32,
         init_method=None,
+        dispatch_dtype: Optional[str] = None,
     ):
         if top_k < 1 or top_k > num_experts:
             raise ValueError(f"top_k ({top_k}) must be in [1, {num_experts}]")
@@ -98,6 +113,14 @@ class MoEMLP:
         self.tp_axis = tp_axis
         self.params_dtype = params_dtype
         self.init_method = init_method or tp.scaled_normal(0.02)
+        from apex_tpu.parallel.quantize import canon_wire_dtype
+
+        self.dispatch_dtype = canon_wire_dtype(dispatch_dtype)
+        if self.dispatch_dtype is not None and expert_axis is None:
+            raise ValueError(
+                "dispatch_dtype requires expert_axis: the quantized wire "
+                "rides the expert-parallel all_to_all dispatch/combine "
+                "exchange — a serial MoE layer has no wire to quantize")
 
     # -- parameters ---------------------------------------------------------
 
@@ -211,10 +234,17 @@ class MoEMLP:
         one einsum pair so all experts' GEMMs fuse into two MXU calls.
 
         With ``tp_axis`` the ffn dim is sharded (fc1 column-parallel, fc2
-        row-parallel): the fc2 einsum yields partial sums, reduced by one
-        identity-backward psum per call — the Megatron Row/Column pair
+        row-parallel): the input rides the identity-forward/psum-backward
+        ``copy_to`` (Megatron's f conjugate — each model rank consumes the
+        same tokens but backpropagates only its ffn slice's partial
+        cotangent, so without the backward psum every upstream gradient
+        would be 1/tp short: the EP x TP backward bug ISSUE 15's
+        equivalence suite caught) and the fc2 einsum's partial sums reduce
+        through one identity-backward psum — the full Row/Column pair
         inside every expert."""
         dt = x.dtype
+        if self.tp_axis is not None:
+            x = tp.copy_to_tensor_model_parallel_region(x, self.tp_axis)
         h = jnp.einsum("ecd,edf->ecf", x,
                        params["fc1"]["kernel"].astype(dt))
         h = jax.nn.gelu(h + params["fc1"]["bias"].astype(dt)[:, None, :])
@@ -239,6 +269,24 @@ class MoEMLP:
             return out.reshape(shape), self._aux_losses(stats)
 
     # -- expert-parallel forward --------------------------------------------
+
+    def _dispatch_exchange(self, x: jax.Array, *, split_axis: int,
+                           concat_axis: int) -> jax.Array:
+        """One dispatch/combine ``all_to_all`` over the expert axis, booked
+        in CommAccount at its wire dtype: the exact fp32/bf16 exchange by
+        default, the encoded 1 B/elem pair under ``dispatch_dtype``
+        (parallel/quantize.quantized_all_to_all — same EQuARX-shaped
+        machinery as the ZeRO grad wire, minus the residual)."""
+        ax = self.expert_axis
+        if self.dispatch_dtype is not None:
+            from apex_tpu.parallel.quantize import quantized_all_to_all
+
+            return quantized_all_to_all(
+                x, ax, self.dispatch_dtype,
+                split_axis=split_axis, concat_axis=concat_axis)
+        with _comm("all_to_all", ax, x):
+            return lax.all_to_all(x, ax, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
 
     def apply_expert_parallel(self, params_local: Params,
                               h_local: jax.Array) -> Tuple[jax.Array, Dict]:
@@ -274,10 +322,11 @@ class MoEMLP:
         dispatch, combine, stats = self._route(params_local, h2d)
         xs = jnp.einsum("nec,nd->ecd", dispatch.astype(h2d.dtype), h2d)
         # exchange: split the expert dim across shards, collect every
-        # shard's bucket for our experts along the capacity dim
-        xs = lax.all_to_all(xs, ax, split_axis=0, concat_axis=1, tiled=True)
+        # shard's bucket for our experts along the capacity dim (booked in
+        # CommAccount; encoded to 1 B/elem under dispatch_dtype)
+        xs = self._dispatch_exchange(xs, split_axis=0, concat_axis=1)
         ys = self._experts(params_local, xs)  # (E/ep, ep*C, d)
-        ys = lax.all_to_all(ys, ax, split_axis=1, concat_axis=0, tiled=True)
+        ys = self._dispatch_exchange(ys, split_axis=1, concat_axis=0)
         out = jnp.einsum("nec,ecd->nd", combine.astype(h2d.dtype), ys)
         # average the raw statistics across shards BEFORE combining — the
         # load-balance loss is bilinear in (me, ce), so averaging finished
@@ -290,3 +339,49 @@ class MoEMLP:
         # like any replicated-param gradient).
         stats = {k: _pmean_value_local_grad(v, ax) for k, v in stats.items()}
         return out.reshape(shape), self._aux_losses(stats)
+
+    # -- expert-sharded inference forward (the serving conjugate) -----------
+
+    def apply_expert_sharded(self, params_local: Params,
+                             h: jax.Array) -> jax.Array:
+        """Inference forward with experts sharded over ``expert_axis`` and
+        tokens REPLICATED across it — the serving decode mapping
+        (apex_tpu/serve/engine.py): every rank holds the same per-slot
+        token batch, so there is no token bucket to exchange; instead each
+        rank routes ALL tokens with the replicated router (bit-identical
+        routing everywhere, same global capacity as serial ``apply``),
+        computes only its local experts' contributions, and one ``psum``
+        over the expert axis combines them. Exactly serial ``apply``'s
+        function — including its global capacity drops — with the combine
+        sum distributed; per-tick top-k indices are data, not shapes, so
+        the decode program's jit signature stays stable
+        (``lint.trace.decode_recompile_hazards``).
+
+        Inference-only (no aux, no gradient contract): training uses
+        :meth:`apply_expert_parallel`, whose token-sharded all_to_all
+        dispatch is the production path."""
+        ax = self.expert_axis
+        if ax is None:
+            raise ValueError("expert_axis is required for expert-sharded "
+                             "inference")
+        ep = lax.axis_size(ax)
+        E = self.num_experts
+        if E % ep:
+            raise ValueError(f"num_experts ({E}) must divide by the "
+                             f"{ax!r} axis size ({ep})")
+        e_local = E // ep
+        shape = h.shape
+        h2d = h.reshape(-1, shape[-1])
+        dispatch, combine, _ = self._route(params_local, h2d)
+        # this rank's expert slab: dispatch/combine columns and the local
+        # expert weights address the same [idx*e_local, (idx+1)*e_local)
+        # window of the global expert dim (specs() shards dim 0 over ax)
+        e0 = lax.axis_index(ax) * e_local
+        disp_l = lax.dynamic_slice_in_dim(dispatch, e0, e_local, axis=1)
+        comb_l = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)
+        xs = jnp.einsum("nec,nd->ecd", disp_l.astype(h2d.dtype), h2d)
+        ys = self._experts(params_local, xs)  # (e_local, C, d)
+        out = jnp.einsum("nec,ecd->nd", comb_l.astype(h2d.dtype), ys)
+        with _comm("psum", ax, out):
+            out = lax.psum(out, ax)
+        return out.reshape(shape)

@@ -59,11 +59,19 @@ from apex_tpu.transformer.pipeline_parallel import prepare_pipelined_model
 # plan_schedule("zero-bubble") interpreted by schedule_grads_fn: explicit
 # W/B-split backward slots instead of the AD-transposed ring; the row's
 # timeline block carries the (S-1)/(3M+S-1) floor next to the 1f1b twin's
-# (S-1)/(M+S-1)). Each marked config records its comm/static-hazard
-# blocks next to the plain twin so the decomposed-collective structure
-# shows up in scaling_table.json.
+# (S-1)/(M+S-1)), "moe" = expert-parallel MoE FFNs (2*dp experts sharded
+# over the data axis, all_to_all token dispatch booked per wire dtype in
+# comm_bytes_by_verb_dtype; the row's moe block carries the capacity/
+# placement arithmetic and the measured dropped fraction), "moe-q8" = the
+# same row with the dispatch wire quantized to int8
+# (GPTConfig.moe_dispatch_dtype — the dispatch rows in
+# comm_bytes_by_verb_dtype land at exactly 1/4 the fp32 twin's bytes).
+# Each marked config records its comm/static-hazard blocks next to the
+# plain twin so the decomposed-collective structure shows up in
+# scaling_table.json.
 GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (8, 1, 1, 1, "zero-q8"),
         (8, 1, 1, 1, "zero3"), (4, 2, 1),
+        (8, 1, 1, 1, "moe"), (8, 1, 1, 1, "moe-q8"),
         (4, 2, 1, 1, "sp"), (2, 1, 4), (4, 1, 2, 1, "zb"),
         (1, 2, 4), (2, 1, 2, 2)]
 
@@ -71,7 +79,7 @@ GRID = [(8, 1, 1), (8, 1, 1, 1, "zero"), (8, 1, 1, 1, "zero-q8"),
 def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
                micro_batch, n_micro, steps, sequence_parallel=False,
                zero=False, zero_level=None, reduce_dtype=None,
-               pp_schedule="1f1b"):
+               pp_schedule="1f1b", moe=False, moe_dispatch_dtype=None):
     n_dev = dp * tp * pp * cp
     if len(jax.devices()) < n_dev:
         return None
@@ -88,6 +96,15 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
         # layer count must divide by pp for the stage shards; record the
         # effective value so ramped sweeps are labeled with what actually ran
         eff_layers = max(layers, pp) // pp * pp
+        moe_kwargs = {}
+        if moe:
+            # the standard MoE mapping: experts shard over the data axis
+            # (token shards ARE the expert shards, transformer/moe.py)
+            moe_kwargs = dict(
+                moe_num_experts=2 * dp, moe_top_k=2,
+                moe_capacity_factor=1.25,
+                moe_expert_axis=mesh_lib.AXIS_DATA if dp > 1 else None,
+                moe_dispatch_dtype=moe_dispatch_dtype)
         cfg = GPTConfig(
             vocab_size=vocab, hidden_size=hidden,
             num_layers=eff_layers,
@@ -96,6 +113,7 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             sequence_parallel=sequence_parallel and tp > 1,
             context_axis=mesh_lib.AXIS_CONTEXT if cp > 1 else None,
             compute_dtype=jnp.bfloat16, remat=True,
+            **moe_kwargs,
         )
         model = GPTModel(cfg)
         policy = amp.get_policy("O2")
@@ -106,9 +124,10 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             gather_dtype="bf16" if zero else None,
             reduce_dtype=reduce_dtype if zero else None)
         full = amp.cast_params(model.init(jax.random.PRNGKey(0)), policy)
-        # shared TP x PP wiring (specs, placement, pipelined loss)
+        # shared TP x PP wiring (specs, placement, pipelined loss;
+        # with_aux threads MoE router losses through the ring)
         specs, params, pipe_loss = prepare_pipelined_model(
-            model, full, mesh, num_microbatches=n_micro)
+            model, full, mesh, num_microbatches=n_micro, with_aux=moe)
         rest_specs = {k: v for k, v in specs.items() if k != "layers"}
         grad_axes = mesh_lib.get_gradient_reduction_axes()
         data_spec = P(mesh_lib.AXIS_DATA,
@@ -225,6 +244,10 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
             conf["reduce_dtype"] = reduce_dtype
         if pp_schedule != "1f1b":
             conf["pp_schedule"] = pp_schedule
+        if moe:
+            conf["moe"] = True
+            if moe_dispatch_dtype:
+                conf["moe_dispatch_dtype"] = moe_dispatch_dtype
         row = {
             "config": conf,
             "avg_iteration_time_s": round(dt, 4),
@@ -304,6 +327,39 @@ def run_config(dp, tp, pp, cp=1, *, hidden, layers, heads, vocab, seq,
                 health_mod.scan(step_records))
         except Exception as e:  # noqa: BLE001 - health stamp is best-effort
             row["alerts"] = {"error": str(e)[:120]}
+        if moe:
+            # the capacity/placement story (ISSUE 15): bucket arithmetic
+            # (per-shard static dispatch shapes) next to the measured
+            # dispatch wire bytes already in comm_bytes_by_verb_dtype —
+            # tokens dropped vs padding waste vs wire bytes in one block
+            import math
+
+            E = cfg.moe_num_experts
+            # the STATIC dispatch shape is per ROUTING CALL: each
+            # microbatch's (micro_batch * seq) shard-local tokens route
+            # independently (MoEMLP._route reads h2d.shape[0]); per-step
+            # aggregates multiply by n_micro explicitly below
+            tokens_call = micro_batch * seq
+            cap = max(1, math.ceil(cfg.moe_top_k * tokens_call
+                                   * cfg.moe_capacity_factor / E))
+            wire_itemsize = 1 if moe_dispatch_dtype else 2  # bf16 compute
+            row["moe"] = {
+                "experts": E, "top_k": cfg.moe_top_k,
+                "capacity_factor": cfg.moe_capacity_factor,
+                "num_microbatches": n_micro,
+                "tokens_per_call": tokens_call,
+                "capacity_per_call": cap,
+                "bucket_slots_per_call": E * cap,
+                "routed_selections_per_call": cfg.moe_top_k * tokens_call,
+                "slot_utilization_bound": round(
+                    min(1.0, cfg.moe_top_k * tokens_call / (E * cap)), 4),
+                "dispatch_wire_dtype": moe_dispatch_dtype or "bf16",
+                # analytic per-shard bytes per layer per STEP: dispatch +
+                # combine exchanges of the (E, C, h) bucket, once per
+                # microbatch
+                "dispatch_bytes_per_layer_step": 2 * E * cap * hidden
+                * wire_itemsize * n_micro,
+            }
         try:
             # static hazard scan per config (apex_tpu/lint/trace.py):
             # lane-padding waste at HBM/custom-call boundaries of THIS
@@ -500,13 +556,16 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                       else 2 if "zero" in marks or reduce_dtype else 0)
         zero = zero_level > 0
         pp_schedule = "zerobubble" if "zb" in marks else "1f1b"
+        moe = bool(marks & {"moe", "moe-q8"})
+        moe_dispatch = "int8" if "moe-q8" in marks else None
         for layers in layers_list:
             res = run_config(
                 dp, tp, pp, cp, hidden=hidden, layers=layers, heads=heads,
                 vocab=vocab, seq=seq, micro_batch=micro_batch,
                 n_micro=n_micro, steps=steps, sequence_parallel=sp,
                 zero_level=zero_level, reduce_dtype=reduce_dtype,
-                pp_schedule=pp_schedule)
+                pp_schedule=pp_schedule, moe=moe,
+                moe_dispatch_dtype=moe_dispatch)
             if res is None:
                 # not enough devices — no layer count will change that;
                 # record ONE skipped row for this config and move on
@@ -530,12 +589,14 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             # duplicate and silently skip it
             defaults = {"cp": 1, "sequence_parallel": False, "zero": False,
                         "zero_level": 0, "reduce_dtype": None,
-                        "pp_schedule": "1f1b"}
+                        "pp_schedule": "1f1b", "moe": False,
+                        "moe_dispatch_dtype": None}
             base_cfg = {"dp": dp, "tp": tp, "pp": pp, "cp": cp,
                         "sequence_parallel": sp and tp > 1, "zero": zero,
                         "zero_level": zero_level,
                         "reduce_dtype": reduce_dtype,
-                        "pp_schedule": pp_schedule, "layers": eff}
+                        "pp_schedule": pp_schedule, "moe": moe,
+                        "moe_dispatch_dtype": moe_dispatch, "layers": eff}
             if any({k: r["config"].get(k, defaults.get(k, 1))
                     for k in base_cfg} == base_cfg
                    for r in rows):
@@ -558,6 +619,8 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                            else "_zero_q8" if zero and reduce_dtype
                            else "_zero" if zero else "")
                 cp_tag += "_zb" if pp_schedule == "zerobubble" else ""
+                cp_tag += ("_moe_q8" if moe_dispatch
+                           else "_moe" if moe else "")
                 name = f"scaling_dp{dp}_tp{tp}_pp{pp}{cp_tag}_l{eff}.json"
                 atomic_write_json(os.path.join(output_dir, name), res)
     if big_rung:
@@ -587,6 +650,8 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                    else "zeroq8" if c.get("zero") and c.get("reduce_dtype")
                    else "zero" if c.get("zero")
                    else "zb" if c.get("pp_schedule") == "zerobubble"
+                   else "moeq8" if c.get("moe_dispatch_dtype")
+                   else "moe" if c.get("moe")
                    else "-")
         if c.get("placement_rung"):
             z3 = r["param_state_report"]["per_rank"]["zero3"]["total_bytes"]

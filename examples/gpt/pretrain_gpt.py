@@ -111,6 +111,25 @@ def parse_args():
                         "at 1 B/elem + per-chunk fp32 scales, with an "
                         "error-feedback residual in the sharded optimizer "
                         "state (parallel/quantize.py)")
+    p.add_argument("--moe-experts", type=int, default=None, metavar="E",
+                   help="route every layer's FFN through a top-k MoE with "
+                        "E experts (transformer/moe.py); with dp > 1 the "
+                        "experts shard over the data axis and tokens "
+                        "dispatch with all_to_all (expert parallelism — "
+                        "EP x TP when --tp > 1); aux router losses fold "
+                        "into the loss via aux_to_loss")
+    p.add_argument("--moe-top-k", type=int, default=2,
+                   help="experts per token (1 = Switch, 2 = GShard)")
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                   help="capacity slack over the balanced share; tokens "
+                        "over an expert's cap are dropped (the "
+                        "dropped_fraction aux metric reports the rate)")
+    p.add_argument("--moe-dispatch-dtype", default=None,
+                   choices=["int8", "e5m2"],
+                   help="quantize the expert-parallel dispatch/combine "
+                        "all_to_all wire to 1 B/elem + fp32 per-block "
+                        "scales (parallel/quantize.quantized_all_to_all; "
+                        "needs --moe-experts and dp > 1)")
     p.add_argument("--data", default=None, help="dir of .bin int32 token files")
     p.add_argument("--save-dir", default=None)
     p.add_argument("--save-every", type=int, default=100)
@@ -169,6 +188,17 @@ def parse_args():
         if not args.unroll:
             p.error("--zero3-prefetch requires --unroll (the prefetch "
                     "schedule is a static unrolled structure)")
+    if args.moe_dispatch_dtype and not args.moe_experts:
+        p.error("--moe-dispatch-dtype requires --moe-experts (it is the "
+                "expert-parallel dispatch wire dtype)")
+    if args.moe_experts:
+        if (args.zero_level or 0) >= 3:
+            p.error("--moe-experts composes with ZeRO levels 1/2 only "
+                    "(level 3's chunk drive has no expert-shard story)")
+        if args.pp_schedule == "zerobubble":
+            p.error("--moe-experts does not compose with --pp-schedule "
+                    "zerobubble (the W/B-split executor has no aux-loss "
+                    "plumbing)")
     return args
 
 
@@ -184,6 +214,18 @@ def main():
     dp = mesh_lib.get_data_parallel_world_size()
     assert args.layers % max(args.pp * args.vpp, 1) == 0
 
+    moe_kwargs = {}
+    if args.moe_experts:
+        # experts shard over the data axis (the standard MoE mapping:
+        # token shards ARE the expert shards) when dp > 1; serial experts
+        # otherwise (one code path — the serial twin of the same config)
+        moe_kwargs = dict(
+            moe_num_experts=args.moe_experts,
+            moe_top_k=args.moe_top_k,
+            moe_capacity_factor=args.moe_capacity_factor,
+            moe_expert_axis=mesh_lib.AXIS_DATA if dp > 1 else None,
+            moe_dispatch_dtype=args.moe_dispatch_dtype,
+        )
     cfg = GPTConfig(
         vocab_size=args.vocab,
         hidden_size=args.hidden,
@@ -196,6 +238,7 @@ def main():
         remat=True,
         unroll_layers=args.unroll,
         zero3_prefetch=args.zero3_prefetch,
+        **moe_kwargs,
     )
     model = GPTModel(cfg)
     policy = amp.get_policy(args.opt_level)
@@ -252,12 +295,17 @@ def main():
     data_spec = P(mesh_lib.AXIS_DATA)
     rest_specs = {k: v for k, v in all_specs.items() if k != "layers"}
     grad_axes = mesh_lib.get_gradient_reduction_axes()
+    # MoE layers emit router aux losses: thread them through the ring and
+    # fold with aux_to_loss (run_layers refuses to drop them silently)
+    with_aux = bool(args.moe_experts)
     pipe_loss = pipelined_loss_fn(
         embed=model.embed,
-        run_layers=lambda lp, h: model.run_layers(lp, h),
+        run_layers=(lambda lp, h: model.run_layers(lp, h, return_aux=True))
+        if with_aux else (lambda lp, h: model.run_layers(lp, h)),
         head_loss=lambda p, h, t: model.head(p, h, t),
         num_microbatches=args.num_microbatches,
         virtual_pipeline_size=args.vpp,
+        aux_to_loss=model.aux_to_loss if with_aux else None,
     )
     zb_vg = None
     if args.pp_schedule == "zerobubble":
@@ -393,7 +441,9 @@ def main():
                   "dp": dp, "hidden": args.hidden, "layers": args.layers,
                   "seq": args.seq, "batch": batch, "zero": bool(args.zero),
                   "zero_level": args.zero_level or 0,
-                  "reduce_dtype": args.reduce_dtype or "fp32"},
+                  "reduce_dtype": args.reduce_dtype or "fp32",
+                  "moe_experts": args.moe_experts or 0,
+                  "moe_dispatch_dtype": args.moe_dispatch_dtype or "none"},
             # online health rules (monitor/health.py): every record
             # streams through the detectors; kind="alert" rows land in
             # this same journal for report's alerts section and the

@@ -26,6 +26,10 @@ import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+from apex_tpu.utils.compat import ensure_jax_compat
+
+ensure_jax_compat()  # jax<0.5: shard_map/axis_size API renames
+
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -880,6 +880,83 @@ def test_quantized_comm_on_real_mixed_precision_step(zero_amp_step_irs):
 
 
 # ---------------------------------------------------------------------------
+# engine 2: MoE dispatch tripwire (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _moe_fixture(dispatch_dtype=None):
+    """An expert-parallel MoE layer + (full, per-shard) param pair at a
+    shape whose dispatch buckets clear the bulk floor (E=8, C=128, d=8:
+    8192 elems/bucket)."""
+    import jax
+
+    from apex_tpu.transformer.moe import MoEMLP
+
+    layer = MoEMLP(8, 16, num_experts=8, top_k=2, capacity_factor=2.0,
+                   expert_axis="data", dispatch_dtype=dispatch_dtype)
+    full = layer.init(jax.random.PRNGKey(0))
+    local = {"router": full["router"],
+             "fc1": jax.tree.map(lambda v: v[:1], full["fc1"]),
+             "fc2": jax.tree.map(lambda v: v[:1], full["fc2"])}
+    return layer, full, local, jnp.ones((256, 8), jnp.float32)
+
+
+def test_moe_dispatch_flags_replicated_experts():
+    """An expert-parallel request whose trace has NO dispatch-shaped
+    all_to_all on the expert axis silently runs every expert on every
+    rank — the replicated-expert regression."""
+    layer, full, _, x = _moe_fixture()
+    hz = trace.moe_dispatch_hazards(layer.apply, full, x, axes={"data": 8})
+    assert hz["hazard"] and hz["dispatch_all_to_alls"] == 0, hz
+    assert hz["findings"][0]["rule"] == "moe-dispatch-missing"
+
+
+def test_moe_dispatch_passes_expert_parallel_and_checks_wire():
+    """The real all_to_all dispatch passes; the SAME exact-wire dispatch
+    under a quantized-wire request flags fat-wire; the encoded exchange
+    (dispatch_dtype='int8') passes the wire check with its fp32 scale
+    side-channel below the bulk floor."""
+    layer, _, local, x = _moe_fixture()
+    hz = trace.moe_dispatch_hazards(
+        layer.apply_expert_parallel, local, x, axes={"data": 8})
+    assert not hz["hazard"] and hz["dispatch_all_to_alls"] == 2, hz
+    assert hz["census"]["dispatch"] == {"4": {"all_to_all": 2}}
+
+    fat = trace.moe_dispatch_hazards(
+        layer.apply_expert_parallel, local, x, axes={"data": 8},
+        wire_dtype="int8")
+    assert fat["hazard"] and fat["fat_dispatches"] == 2, fat
+    assert fat["findings"][0]["rule"] == "moe-dispatch-fat-wire"
+
+    qlayer, _, qlocal, _ = _moe_fixture(dispatch_dtype="int8")
+    ok = trace.moe_dispatch_hazards(
+        qlayer.apply_expert_parallel, qlocal, x, axes={"data": 8},
+        wire_dtype="int8")
+    assert not ok["hazard"], ok
+    assert ok["census"]["dispatch"] == {"1": {"all_to_all": 2}}
+
+
+def test_moe_dispatch_ignores_zero_grad_chunk_all_to_alls():
+    """The quantized ZeRO grad reduce's rank-2 chunk-row all_to_alls on
+    the SAME mesh axis land in census['chunk'], never the dispatch table
+    — a zero+moe hybrid step audits each wire independently."""
+    from apex_tpu.parallel.quantize import quantized_reduce_scatter
+
+    def grad_reduce(g):
+        chunk, _ = quantized_reduce_scatter(g, 8, "data", "int8")
+        return chunk / 8
+
+    hz = trace.moe_dispatch_hazards(
+        grad_reduce, jnp.ones((64, 128), jnp.float32), axes={"data": 8},
+        wire_dtype="int8")
+    assert not hz["census"]["dispatch"], hz
+    assert hz["census"]["chunk"] == {"1": {"all_to_all": 1}}
+    # missing-dispatch still fires (there IS no dispatch) — callers hand
+    # the tripwire the MoE step, not a bare grad reduce
+    assert hz["findings"][0]["rule"] == "moe-dispatch-missing"
+
+
+# ---------------------------------------------------------------------------
 # engine 2: recompile-hazard scanner
 # ---------------------------------------------------------------------------
 

@@ -278,9 +278,10 @@ class TestSampler:
 
 class TestFlashDecode:
     def _pages(self, kh=2, d=16, n=10, blk=8):
+        # (n, kh, blk, d): block in the sublane dim (ISSUE 15 re-layout)
         rng = np.random.default_rng(3)
-        kp = jnp.asarray(rng.normal(size=(n, blk, kh, d)), jnp.float32)
-        vp = jnp.asarray(rng.normal(size=(n, blk, kh, d)), jnp.float32)
+        kp = jnp.asarray(rng.normal(size=(n, kh, blk, d)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(n, kh, blk, d)), jnp.float32)
         return kp, vp
 
     @pytest.mark.parametrize("window", [None, 5])
@@ -310,9 +311,12 @@ class TestFlashDecode:
         L = 19
         out = paged_attention_reference(q, kp, vp, tables,
                                         jnp.asarray([L], jnp.int32))
-        k = jnp.repeat(kp[tables[0]].reshape(-1, 2, 16)[:L], 2,
+        # (nb, kh, blk, d) -> positions-major (nb*blk, kh, d)
+        k = jnp.repeat(kp[tables[0]].transpose(0, 2, 1, 3)
+                       .reshape(-1, 2, 16)[:L], 2,
                        axis=1).transpose(1, 0, 2)[None]
-        v = jnp.repeat(vp[tables[0]].reshape(-1, 2, 16)[:L], 2,
+        v = jnp.repeat(vp[tables[0]].transpose(0, 2, 1, 3)
+                       .reshape(-1, 2, 16)[:L], 2,
                        axis=1).transpose(1, 0, 2)[None]
         dense = mha_reference(q[:, :, None, :], k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(dense[:, :, 0]),
@@ -354,6 +358,24 @@ class TestFlashDecode:
                                                lj, window=window)
             np.testing.assert_allclose(np.asarray(ref[:, :, j]),
                                        np.asarray(single), atol=1e-5)
+
+    def test_pool_layout_kills_sublane_pad(self):
+        """The ISSUE 13 static-hbm catch, fixed: at the flagged serve
+        shape (f32, 4 kv heads, head_dim 64, block 16) the re-laid pool
+        (block in the sublane dim) pays only the head_dim lane pad (2x),
+        not the old layout's extra heads->sublane pad (4x total)."""
+        from apex_tpu.monitor.hbm import lane_padded_bytes
+        from apex_tpu.serve.cache import KVCacheConfig
+
+        cfg = KVCacheConfig(num_layers=2, kv_heads=4, head_dim=64,
+                            block_size=16, num_blocks=8, dtype=jnp.float32)
+        shape = cfg.page_shape
+        assert shape == (2, 8, 4, 16, 64)  # heads OUTSIDE the tiled pair
+        logical = int(np.prod(shape)) * 4
+        assert lane_padded_bytes(shape, 4) / logical <= 2.0
+        # the pre-ISSUE-15 order pays the full 4x at the same shape
+        old = (2, 8, 16, 4, 64)
+        assert lane_padded_bytes(old, 4) / logical == 4.0
 
     def test_multi_query_k1_equals_single(self):
         from apex_tpu.ops.flash_decode import flash_decode_multi

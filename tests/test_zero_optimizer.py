@@ -262,15 +262,36 @@ def test_zero_grad_norm_matches_replicated_hybrid_tp():
             rtol=1e-5, err_msg=k)
 
 
-def test_zero_rejects_params_sharded_over_zero_axis(mesh):
-    """MoE-style data-sharded params cannot be ZeRO-chunked over their own
-    axis — the wiring must fail loudly, not silently mix expert shards."""
+def test_zero_composes_with_params_sharded_over_zero_axis(mesh):
+    """MoE-style data-sharded params COMPOSE with ZeRO at levels 1/2
+    (ISSUE 15): their masters/moments stay the fp32 local shard (not a
+    chunk), the sharded-state specs carry the param's own PartitionSpec,
+    and the residual leaf is empty (no reduce wire). Level 3 still
+    rejects — the chunk drive has no expert-shard gather story."""
     policy = amp.get_policy("O2")
-    params = {"experts": jnp.ones((N, 4, 4), jnp.bfloat16)}
+    n = mesh.shape["data"]
+    params = {"experts": jnp.ones((N, 4, 4), jnp.bfloat16),
+              "dense": jnp.ones((N, 4), jnp.bfloat16)}
+    specs = {"experts": P("data", None, None), "dense": P()}
     z = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-2), policy,
-                                    zero_axis="data")
-    with pytest.raises(ValueError, match="SHARDED over the zero axis"):
-        z.zero_abstract_state(params, mesh, {"experts": P("data", None)})
+                                    zero_axis="data", reduce_dtype="int8")
+    abstract = z.zero_abstract_state(params, mesh, specs)
+    # expert master: the LOCAL fp32 shard; dense master: the 1-D chunk
+    assert abstract.master["experts"].shape == (N // n, 4, 4)
+    assert abstract.master["experts"].dtype == jnp.float32
+    assert abstract.master["dense"].ndim == 1
+    # sharded-state specs: expert leaves carry the param's own spec
+    sspecs = z.zero_state_specs(abstract, mesh)
+    assert sspecs.master["experts"] == specs["experts"]
+    assert sspecs.master["dense"] == P(tuple(mesh.axis_names))
+    # no reduce wire for the sharded leaf: empty residual
+    assert abstract.residual["err"]["experts"].shape == (0,)
+    assert abstract.residual["err"]["dense"].shape[0] > 0
+
+    z3 = amp.MixedPrecisionOptimizer(FusedAdam(lr=1e-2), policy,
+                                     zero_axis="data", zero_level=3)
+    with pytest.raises(ValueError, match="zero_level=3 requires"):
+        z3.zero3_meta(params, mesh, specs)
 
 
 def test_gather_dtype_requires_zero_axis():
