@@ -60,6 +60,18 @@ extracts those patterns into a reusable subsystem any training loop
   live one-screen tail of a running journal (+ heartbeat/flight files):
   step rate, loss, HBM, bubble/overlap, serve queue + SLO, the last
   breadcrumb, and the alert feed; ``--once --format json`` for machines.
+- :mod:`ledger` — ``python -m apex_tpu.monitor.ledger`` (ISSUE 16): an
+  append-only run ledger — one fingerprinted record per completed run
+  (config + environment stamp + measured ``report`` rollup + the
+  predicted block from the static passes); ``trend`` renders
+  per-fingerprint trajectories, ``regress`` gates the newest run against
+  its fingerprint's history through the shared predicates (the N-run
+  generalization of ``report compare``).
+- :mod:`calibrate` — predicted-vs-measured joins per ledger record
+  (hbm/bubble/comm/wall error ratios) and the fitted effective
+  peak-FLOPs / peak-ICI constants; an armed ``APEX_TPU_CALIBRATION``
+  file outranks the ``APEX_TPU_PEAK_*`` env overrides in
+  ``mfu.peak_spec`` / ``tracing.ici_spec``.
 - :mod:`selftest` — ``python -m apex_tpu.monitor.selftest``: fast off-TPU
   smoke of all pieces, wired into ``__graft_entry__.dryrun_multichip``.
 
@@ -116,3 +128,7 @@ from apex_tpu.monitor.flight import (  # noqa: F401
 from apex_tpu.monitor.health import (  # noqa: F401
     HealthMonitor,
 )
+
+# ledger/calibrate/report/status/selftest are deliberately NOT imported
+# here: they are `python -m apex_tpu.monitor.<name>` CLI entry points and
+# importing them in the package init trips runpy's double-import warning

@@ -150,7 +150,21 @@ class MetricsJournal:
         self._step_comm: Optional[Dict[str, Any]] = None
         self._bubble: Optional[Dict[str, Any]] = None
         if meta:
-            self.log(dict(meta, kind="meta"))
+            # provenance header (ISSUE 16): config fingerprint + the
+            # environment stamp (git rev, jax/platform versions, peak
+            # overrides) so ledger/report joins read provenance from the
+            # journal instead of re-deriving it per harness. Bare
+            # journals (meta omitted) stay record-for-record unchanged.
+            header = dict(meta)
+            try:
+                from apex_tpu.monitor import ledger as _ledger
+
+                header.setdefault(
+                    "fingerprint", _ledger.config_fingerprint(meta))
+                header.setdefault("env", _ledger.environment_stamp())
+            except Exception:  # noqa: BLE001 - provenance is best-effort
+                pass
+            self.log(dict(header, kind="meta"))
 
     # -- MFU arming (monitor/mfu.py) ----------------------------------------
     def set_step_costs(

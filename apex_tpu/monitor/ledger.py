@@ -1,0 +1,627 @@
+"""Run ledger: one append-only record per completed run, across runs.
+
+``report compare`` is strictly pairwise and a journal dies with its run;
+nothing tracked run N against runs 1..N-1 and nothing joined the repo's
+*predictions* (static-hbm peak bytes, comm census, analytic bubble
+floors, pyprof FLOPs) with its *measurements* (journal → ``report``).
+The ledger is that longitudinal layer: a JSON-lines file sharing the
+journal's strict-JSON / truncated-read semantics, one ``kind="run"``
+record per completed run carrying
+
+- ``fingerprint`` + ``config``: the canonicalized parallelism knobs
+  (dp/tp/sp/pp/vpp/schedule/zero_level/prefetch/reduce_dtype/moe axis/
+  serve knobs) hashed so trajectories group by config, not by path;
+- ``env``: provenance (git rev, jax/python versions, device platform,
+  the ``APEX_TPU_PEAK_*`` / calibration overrides in force);
+- ``measured``: ``report.analyze``'s single-journal rollup (the same
+  JSON object ``report --format json`` emits);
+- ``predicted``: the off-TPU block from the existing static passes
+  (per-step FLOPs/bytes, static comm bytes, analytic bubble floor,
+  static-hbm peak estimate, and the modeled step seconds those imply
+  under the current peak spec).
+
+CLI: ``python -m apex_tpu.monitor.ledger {list,trend,regress,calibrate}``.
+``trend`` renders per-fingerprint trajectories; ``regress`` is the N-run
+generalization of ``report compare`` — the newest record gates against
+the median of its fingerprint's history through the SAME
+``must_not_drop``/``must_not_grow`` predicates, emits the same machine
+shape as ``report compare --format json``, and exits non-zero on
+regression; ``calibrate`` joins predicted vs measured per record
+(``monitor/calibrate.py``) and fits the effective peak constants
+``mfu.peak_spec``/``tracing.ici_spec`` consume.
+
+Harness wiring: ``pretrain_gpt/pretrain_bert/generate_gpt --ledger``,
+``BENCH_LEDGER``/``APEX_TPU_LEDGER`` env, one row per ``gpt_scaling``
+config. Appends are single ``O_APPEND`` writes (concurrent harnesses
+interleave whole lines, the journal's shared-file discipline); disarmed
+programs are untouched.
+
+No reference-file citation: NVIDIA Apex has no run-tracking layer; this
+generalizes the repo's own journal/report discipline across runs
+(ROADMAP items 1-3 read from it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.monitor.journal import (
+    JournalRecords,
+    MetricsJournal,
+    _sanitize_nonfinite,
+    _to_host,
+)
+
+ENV_LEDGER = "APEX_TPU_LEDGER"
+
+SCHEMA_VERSION = 1
+
+#: shared crash-tolerant reader: a ledger torn by a kill must still parse
+read = MetricsJournal.read
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + environment provenance
+# ---------------------------------------------------------------------------
+
+
+def _canonical(v: Any) -> Any:
+    """Canonicalize a config tree: sorted keys, ``None`` values dropped
+    (an omitted knob and an explicit None are the same config), scalars
+    kept, everything else stringified."""
+    if isinstance(v, dict):
+        return {str(k): _canonical(x) for k, x in sorted(v.items())
+                if x is not None}
+    if isinstance(v, (list, tuple)):
+        return [_canonical(x) for x in v]
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def config_fingerprint(config: Optional[Dict[str, Any]]) -> str:
+    """Stable 12-hex-char fingerprint of a config dict. Same knobs →
+    same fingerprint regardless of key order or None-vs-omitted; any
+    parallelism knob flip → a new fingerprint (tests pin both)."""
+    blob = json.dumps(_canonical(config or {}), sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+_ENV_STAMP: Optional[Dict[str, Any]] = None
+
+#: env knobs whose values are provenance (a run measured under an
+#: env-overridden peak spec must not trend against a datasheet one)
+_PEAK_ENV_KEYS = ("APEX_TPU_PEAK_FLOPS", "APEX_TPU_PEAK_HBM_GBPS",
+                  "APEX_TPU_PEAK_ICI_GBPS", "APEX_TPU_CALIBRATION")
+
+
+def environment_stamp() -> Dict[str, Any]:
+    """Provenance stamp: git rev, jax/python versions, device platform,
+    peak-spec overrides in force. Cached per process (the git subprocess
+    runs once); every field is best-effort — a stamp must never fail a
+    run or a journal open."""
+    global _ENV_STAMP
+    if _ENV_STAMP is not None:
+        return dict(_ENV_STAMP)
+    stamp: Dict[str, Any] = {
+        "python": ".".join(map(str, sys.version_info[:3])),
+    }
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        if out.returncode == 0 and out.stdout.strip():
+            stamp["git"] = out.stdout.strip()
+    except Exception:  # noqa: BLE001 - no git is fine
+        pass
+    try:
+        import jax
+
+        stamp["jax"] = jax.__version__
+        devs = jax.devices()
+        stamp["device_count"] = len(devs)
+        stamp["device_platform"] = (
+            f"{devs[0].platform} "
+            f"{getattr(devs[0], 'device_kind', '') or ''}").strip()
+    except Exception:  # noqa: BLE001 - no backend: stay host-side
+        pass
+    overrides = {k: os.environ[k] for k in _PEAK_ENV_KEYS
+                 if os.environ.get(k)}
+    if overrides:
+        stamp["peak_overrides"] = overrides
+    _ENV_STAMP = stamp
+    return dict(stamp)
+
+
+# ---------------------------------------------------------------------------
+# append
+# ---------------------------------------------------------------------------
+
+
+def append(path: str, record: Dict[str, Any]) -> Dict[str, Any]:
+    """Append one record as a single ``O_APPEND`` write (whole lines
+    interleave under concurrent writers — the journal's shared-file
+    semantics). Values sanitize to strict JSON exactly like journal
+    lines (non-finite floats → null + ``nonfinite_keys``)."""
+    rec = {"v": SCHEMA_VERSION, "kind": record.get("kind", "run"),
+           "ts": round(time.time(), 3)}
+    for k, v in record.items():
+        rec[k] = _to_host(v)
+    bad: List[str] = []
+    rec = _sanitize_nonfinite(rec, "", bad)
+    if bad:
+        rec["nonfinite_keys"] = bad
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    data = (json.dumps(rec, default=str, allow_nan=False) + "\n").encode()
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+    return rec
+
+
+def _measured_block(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The measured side: ``report.analyze``'s rollup, trimmed for a
+    per-run record (short lists; the journal keeps the long form)."""
+    from apex_tpu.monitor import report
+
+    out = report.analyze(records, max_list=5)
+    # provenance rides the ledger record's own config/env blocks; the
+    # journal meta would duplicate it per run
+    out.pop("meta", None)
+    return out
+
+
+def _finish_predicted(pred: Dict[str, Any]) -> Dict[str, Any]:
+    """Derive the modeled step seconds (cost-model compute + wire-model
+    comm under the CURRENT peak spec) from whatever static pieces the
+    caller provided, stamping the spec provenance so a calibrated re-read
+    is distinguishable from a datasheet one."""
+    flops = pred.get("flops_per_step")
+    comm = pred.get("comm_bytes_per_step")
+    if not (isinstance(flops, (int, float)) and flops > 0) and not (
+            isinstance(comm, (int, float)) and comm > 0):
+        return pred
+    try:
+        from apex_tpu.monitor import mfu as _mfu
+        from apex_tpu.monitor import tracing as _tracing
+
+        spec = _mfu.peak_spec()
+        ici = _tracing.ici_spec()
+        compute_s = (flops / spec["peak_flops"]
+                     if isinstance(flops, (int, float)) and flops > 0
+                     else 0.0)
+        comm_s = (comm / ici["ici_bytes_per_sec"]
+                  if isinstance(comm, (int, float)) and comm > 0 else 0.0)
+        # the no-overlap model: an upper bound a well-overlapped step
+        # beats (wall_ratio < 1), a stalled one misses (wall_ratio > 1)
+        pred["modeled_step_s"] = round(compute_s + comm_s, 6)
+        pred["spec"] = {
+            "peak_flops": spec["peak_flops"],
+            "peak_flops_source": spec["source"],
+            "ici_bytes_per_sec": ici["ici_bytes_per_sec"],
+            "ici_source": ici["source"],
+        }
+    except Exception:  # noqa: BLE001 - prediction is best-effort
+        pass
+    return pred
+
+
+def append_run(
+    path: str,
+    *,
+    run: str,
+    config: Optional[Dict[str, Any]] = None,
+    journal: Optional[str] = None,
+    records: Optional[Sequence[Dict[str, Any]]] = None,
+    measured: Optional[Dict[str, Any]] = None,
+    predicted: Optional[Dict[str, Any]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The one-call harness hook: read the run's journal (when given),
+    roll it up into the measured block, finish the predicted block, and
+    append one fingerprinted ``kind="run"`` record.
+
+    ``predicted`` carries whatever static pieces the harness computed at
+    arm time — ``flops_per_step``/``bytes_per_step`` (pyprof trace),
+    ``comm_bytes_per_step`` (comm census), ``hbm_peak_bytes``
+    (static-hbm pass), ``bubble_floor`` (analytic) — missing pieces are
+    salvaged from the journal's own armed stamps. ``measured`` overrides
+    the journal rollup for harnesses that journal nothing (a minimal
+    ``{"tokens_per_sec": {"p50": ...}}``-shaped dict).
+    """
+    if records is None and journal:
+        try:
+            records = read(journal)
+        except OSError:
+            records = None
+    if measured is None:
+        measured = _measured_block(records) if records else {}
+    pred = dict(predicted or {})
+    if records:
+        steps = [r for r in records if r.get("kind") == "step"]
+        if "bubble_floor" not in pred:
+            floor = next((r["bubble_fraction_expected"] for r in steps
+                          if isinstance(r.get("bubble_fraction_expected"),
+                                        (int, float))), None)
+            if floor is not None:
+                pred["bubble_floor"] = floor
+    pred = _finish_predicted(pred)
+    canon = _canonical(config or {})
+    rec = {
+        "kind": "run",
+        "run": run,
+        "fingerprint": config_fingerprint(config),
+        "config": canon,
+        "env": environment_stamp(),
+        "measured": measured,
+        "predicted": pred,
+    }
+    if extra:
+        rec.update(extra)
+    return append(path, rec)
+
+
+def append_scaling_row(path: str, row: Dict[str, Any],
+                       *, run: str = "gpt_scaling") -> Optional[Dict[str, Any]]:
+    """One ledger record per ``benchmarks/gpt_scaling.py`` config row:
+    the row's measurements become the measured block, its static
+    census/floor the predicted block. Skipped rows return None."""
+    if "skipped" in row or row.get("config", {}).get("placement_rung"):
+        return None
+    measured: Dict[str, Any] = {"step_records": 1}
+    if isinstance(row.get("tokens_per_sec"), (int, float)):
+        measured["tokens_per_sec"] = {"p50": row["tokens_per_sec"]}
+    if isinstance(row.get("avg_iteration_time_s"), (int, float)):
+        measured["wall_s"] = {"p50": row["avg_iteration_time_s"]}
+    if isinstance(row.get("loss"), (int, float)):
+        measured["loss"] = {"last": row["loss"]}
+    for key in ("comm_bytes_by_axis", "comm_bytes_by_verb_dtype"):
+        if isinstance(row.get(key), dict):
+            measured[key] = row[key]
+    mfu = row.get("mfu") or {}
+    if isinstance(mfu.get("mfu"), (int, float)):
+        measured["mfu"] = {"p50": mfu["mfu"],
+                           "peak_source": mfu.get("peak_source")}
+    alerts = row.get("alerts")
+    if isinstance(alerts, dict) and "count" in alerts:
+        measured["alerts"] = alerts
+    tl = row.get("timeline") or {}
+    anatomy = tl.get("anatomy") or {}
+    tl_out: Dict[str, Any] = {}
+    if isinstance(anatomy.get("overlap_fraction"), (int, float)):
+        tl_out["overlap_fraction"] = {"p50": anatomy["overlap_fraction"]}
+    if tl_out:
+        measured["timeline"] = tl_out
+    pred: Dict[str, Any] = {}
+    if isinstance(tl.get("expected_bubble_fraction"), (int, float)) \
+            and tl["expected_bubble_fraction"] > 0:
+        pred["bubble_floor"] = tl["expected_bubble_fraction"]
+    wall = row.get("avg_iteration_time_s")
+    tflops = mfu.get("achieved_tflops")
+    if isinstance(tflops, (int, float)) and isinstance(wall, (int, float)):
+        pred["flops_per_step"] = round(tflops * 1e12 * wall, 1)
+    comm_total = 0.0
+    for axis_row in (row.get("comm_bytes_by_axis") or {}).values():
+        if isinstance(axis_row, dict):
+            comm_total += float(axis_row.get("bytes", 0))
+    if comm_total:
+        pred["comm_bytes_per_step"] = comm_total
+    return append_run(path, run=run, config=row.get("config"),
+                      measured=measured, predicted=pred)
+
+
+# ---------------------------------------------------------------------------
+# trend / regress
+# ---------------------------------------------------------------------------
+
+
+def _dig(d: Dict[str, Any], path: Tuple[str, ...]) -> Optional[float]:
+    cur: Any = d
+    for key in path:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(key)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+#: the trended/gated metric surface: (name, path into the measured
+#: block, direction, absolute slack). Directions reuse report's shared
+#: predicate pair; slacks match ``report.compare``'s per-check choices.
+GATES: Tuple[Tuple[str, Tuple[str, ...], str, float], ...] = (
+    ("tokens_per_sec_p50", ("tokens_per_sec", "p50"), "drop", 0.0),
+    ("wall_s_p50", ("wall_s", "p50"), "grow", 0.0),
+    ("hbm_peak_bytes", ("hbm", "peak_bytes"), "grow", float(64 << 20)),
+    ("bubble_fraction_p50", ("timeline", "bubble_fraction", "p50"),
+     "grow", 0.01),
+    ("overlap_fraction_p50", ("timeline", "overlap_fraction", "p50"),
+     "drop", 0.0),
+    ("opt_state_bytes_last", ("opt_state_bytes", "last"), "grow", 0.0),
+    ("param_bytes_last", ("param_bytes", "last"), "grow", 0.0),
+    ("ttft_ms_p50", ("serving", "ttft_ms", "p50"), "grow", 0.05),
+    ("itl_ms_p50", ("serving", "itl_ms", "p50"), "grow", 0.05),
+    ("itl_ms_p99", ("serving", "itl_ms", "p99"), "grow", 0.5),
+    ("tokens_per_sec_per_user_p50",
+     ("serving", "tokens_per_sec_per_user", "p50"), "drop", 0.0),
+    ("prefix_hit_rate", ("serving", "prefix_hit_rate"), "drop", 0.0),
+    ("accepted_len_p50", ("serving", "accepted_len", "p50"), "drop", 0.0),
+    ("slo_attainment_p50", ("slo", "attainment", "p50"), "drop", 0.0),
+)
+
+
+def _runs(records: Sequence[Dict[str, Any]],
+          fingerprint: Optional[str] = None) -> List[Dict[str, Any]]:
+    out = [r for r in records if r.get("kind") == "run"]
+    if fingerprint:
+        out = [r for r in out if str(r.get("fingerprint", "")
+                                     ).startswith(fingerprint)]
+    return out
+
+
+def _metric_row(rec: Dict[str, Any]) -> Dict[str, Any]:
+    measured = rec.get("measured") or {}
+    row: Dict[str, Any] = {"ts": rec.get("ts"), "run": rec.get("run"),
+                           "step_records": measured.get("step_records")}
+    for name, path, _, _ in GATES:
+        v = _dig(measured, path)
+        if v is not None:
+            row[name] = v
+    loss = _dig(measured, ("loss", "last"))
+    if loss is not None:
+        row["loss_last"] = loss
+    alerts = _dig(measured, ("alerts", "count"))
+    if alerts is not None:
+        row["alerts"] = alerts
+    return row
+
+
+def trend(records: Sequence[Dict[str, Any]],
+          fingerprint: Optional[str] = None) -> Dict[str, Any]:
+    """Per-fingerprint trajectories: for each config fingerprint, the
+    metric rows of its runs in append order — the across-runs view
+    ``report`` cannot give (it sees one journal at a time)."""
+    out: Dict[str, Any] = {}
+    for rec in _runs(records, fingerprint):
+        fp = str(rec.get("fingerprint"))
+        slot = out.setdefault(fp, {"config": rec.get("config"), "rows": []})
+        slot["rows"].append(_metric_row(rec))
+    return out
+
+
+def regress(
+    records: Sequence[Dict[str, Any]],
+    *,
+    fingerprint: Optional[str] = None,
+    threshold: float = 0.05,
+    window: int = 8,
+    max_alerts: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Gate the newest run record against its fingerprint's history.
+
+    The N-run generalization of ``report.compare``: the baseline for
+    each metric is the MEDIAN over the previous ``window`` runs of the
+    same fingerprint (a single noisy predecessor can't poison the gate),
+    and every check reuses the shared ``must_not_drop``/``must_not_grow``
+    predicates. Emits the same machine shape as
+    ``report compare --format json`` (``checks``/``regressed``/``ok``).
+    A first run has no history: every check skips and the verdict is ok
+    (self-history always passes).
+    """
+    from apex_tpu.monitor.diagnose import median as _median
+    from apex_tpu.monitor.report import must_not_drop, must_not_grow
+
+    runs = _runs(records, fingerprint)
+    if not runs:
+        return {"threshold": threshold, "checks": [], "regressed": [],
+                "ok": True, "a": {"runs": 0}, "b": {},
+                "note": "no run records"
+                + (f" for fingerprint {fingerprint}" if fingerprint else "")}
+    cand = runs[-1]
+    history = runs[:-1][-window:]
+    cand_row = _metric_row(cand)
+    hist_rows = [_metric_row(r) for r in history]
+    checks: List[Dict[str, Any]] = []
+
+    def check(name, va, vb, *, worse):
+        if va is None or vb is None:
+            return
+        checks.append({"check": name, "a": va, "b": vb,
+                       "regressed": bool(worse(va, vb))})
+
+    def baseline(name):
+        vals = [r[name] for r in hist_rows if isinstance(
+            r.get(name), (int, float))]
+        return _median(vals) if vals else None
+
+    if history:
+        # structural gate first (report.compare's discipline): a run that
+        # journaled nothing must FAIL against a history that did
+        check("step_records", baseline("step_records"),
+              cand_row.get("step_records", 0),
+              worse=lambda va, vb: va > 0 and vb == 0)
+        for name, _, direction, slack in GATES:
+            pred = (must_not_drop(threshold) if direction == "drop"
+                    else must_not_grow(threshold, slack=slack))
+            check(name, baseline(name), cand_row.get(name), worse=pred)
+        if max_alerts is not None:
+            check("alerts", baseline("alerts") or 0,
+                  cand_row.get("alerts", 0),
+                  worse=lambda va, vb: vb > max(va, max_alerts))
+    regressed = [c["check"] for c in checks if c["regressed"]]
+    return {"threshold": threshold, "checks": checks,
+            "regressed": regressed, "ok": not regressed,
+            "a": {"runs": len(history),
+                  "fingerprint": str(cand.get("fingerprint"))},
+            "b": {"ts": cand.get("ts"), "run": cand.get("run"),
+                  "step_records": cand_row.get("step_records")}}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.monitor.ledger",
+        description="Run-ledger analysis: per-config trajectories, the "
+                    "N-run regression gate, and cost-model calibration.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    lp = sub.add_parser("list", help="one line per run record")
+    lp.add_argument("ledger")
+    lp.add_argument("--format", choices=("text", "json"), default="text")
+
+    tp = sub.add_parser("trend", help="per-fingerprint trajectories")
+    tp.add_argument("ledger")
+    tp.add_argument("--fingerprint", default=None,
+                    help="restrict to one config fingerprint (prefix ok)")
+    tp.add_argument("--format", choices=("text", "json"), default="text")
+
+    rp = sub.add_parser(
+        "regress",
+        help="gate the newest run against its fingerprint's history "
+             "(exit 1 on regression; report compare's machine shape)")
+    rp.add_argument("ledger")
+    rp.add_argument("--fingerprint", default=None)
+    rp.add_argument("--threshold", type=float, default=0.05)
+    rp.add_argument("--window", type=int, default=8,
+                    help="history depth the baseline medians over")
+    rp.add_argument("--max-alerts", type=int, default=None,
+                    help="arm the health-alert gate (report compare "
+                         "--max-alerts semantics)")
+    rp.add_argument("--format", choices=("text", "json"), default="text")
+
+    cp = sub.add_parser(
+        "calibrate",
+        help="join predicted vs measured per record and fit the "
+             "effective peak constants (monitor/calibrate.py)")
+    cp.add_argument("ledger")
+    cp.add_argument("--output", default=None, metavar="PATH",
+                    help="write the fitted calibration file (arm it via "
+                         "APEX_TPU_CALIBRATION=<PATH>; the file then "
+                         "outranks the APEX_TPU_PEAK_* env overrides)")
+    cp.add_argument("--format", choices=("text", "json"), default="text")
+
+    args = p.parse_args(list(sys.argv[1:] if argv is None else argv))
+    try:
+        records = read(args.ledger)
+    except OSError:
+        records = JournalRecords()
+
+    if args.cmd == "list":
+        runs = _runs(records)
+        if args.format == "json":
+            print(json.dumps([_metric_row(r) | {
+                "fingerprint": str(r.get("fingerprint"))} for r in runs]))
+        else:
+            for r in runs:
+                row = _metric_row(r)
+                parts = [f"{str(r.get('fingerprint')):<12}",
+                         f"{str(r.get('run')):<14}"]
+                for key in ("tokens_per_sec_p50", "wall_s_p50",
+                            "loss_last", "hbm_peak_bytes"):
+                    if key in row:
+                        parts.append(f"{key}={_fmt(row[key])}")
+                print("  ".join(parts))
+            print(f"{len(runs)} run record(s)"
+                  + (", TRUNCATED final line"
+                     if getattr(records, "truncated", False) else ""))
+        return 0
+
+    if args.cmd == "trend":
+        tr = trend(records, args.fingerprint)
+        if args.format == "json":
+            print(json.dumps(tr))
+        else:
+            for fp, slot in tr.items():
+                cfg = json.dumps(slot["config"], sort_keys=True)
+                print(f"fingerprint {fp} ({len(slot['rows'])} run(s)) "
+                      f"{cfg}")
+                for row in slot["rows"]:
+                    parts = [f"  ts={row.get('ts')}"]
+                    for key in ("tokens_per_sec_p50", "wall_s_p50",
+                                "loss_last", "bubble_fraction_p50",
+                                "overlap_fraction_p50", "hbm_peak_bytes",
+                                "ttft_ms_p50", "itl_ms_p50", "alerts"):
+                        if key in row:
+                            parts.append(f"{key}={_fmt(row[key])}")
+                    print("  ".join(parts))
+            if not tr:
+                print("no run records")
+        return 0
+
+    if args.cmd == "regress":
+        res = regress(records, fingerprint=args.fingerprint,
+                      threshold=args.threshold, window=args.window,
+                      max_alerts=args.max_alerts)
+        if args.format == "json":
+            print(json.dumps(res))
+        else:
+            for c in res["checks"]:
+                mark = "REGRESSED" if c["regressed"] else "ok"
+                print(f"{c['check']:<28} hist={_fmt(c['a'])} "
+                      f"new={_fmt(c['b'])}  {mark}")
+            if res.get("note"):
+                print(res["note"])
+            print("REGRESSION: " + ", ".join(res["regressed"])
+                  if res["regressed"] else
+                  f"no regression ({res['a']['runs'] if 'runs' in res['a'] else 0} "
+                  f"history run(s))")
+        return 0 if res["ok"] else 1
+
+    if args.cmd == "calibrate":
+        from apex_tpu.monitor import calibrate as cal_mod
+
+        out = {"joins": cal_mod.summarize(records),
+               "fit": cal_mod.fit(records)}
+        if args.output:
+            out["calibration_file"] = cal_mod.save(args.output, out["fit"])
+        if args.format == "json":
+            print(json.dumps(out))
+        else:
+            for fp, row in out["joins"].items():
+                parts = [f"fingerprint {fp} ({row['records']} run(s))"]
+                for key in ("hbm_ratio", "bubble_ratio", "comm_ratio",
+                            "wall_ratio"):
+                    if key in row:
+                        parts.append(f"{key}={row[key]}")
+                print("  ".join(parts))
+            fit = out["fit"]
+            parts = []
+            if "peak_flops" in fit:
+                parts.append(f"peak_flops={fit['peak_flops']:.4g}")
+            if "peak_ici_bytes_per_sec" in fit:
+                parts.append("peak_ici_gbps="
+                             f"{fit['peak_ici_bytes_per_sec'] / 1e9:.4g}")
+            if "peak_hbm_bytes_per_sec" in fit:
+                parts.append("peak_hbm_gbps="
+                             f"{fit['peak_hbm_bytes_per_sec'] / 1e9:.4g}")
+            print("fit: " + (" ".join(parts) if parts
+                             else "not enough signal"))
+            if args.output:
+                print(f"calibration file: {out['calibration_file']} "
+                      f"(arm via APEX_TPU_CALIBRATION)")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

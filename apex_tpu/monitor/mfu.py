@@ -108,6 +108,22 @@ def peak_spec(platform: Optional[str] = None) -> Dict[str, Any]:
             bw, src_b = float(env_b) * 1e9, "env"
     except ValueError:
         pass
+    # an armed calibration file (APEX_TPU_CALIBRATION) outranks the env
+    # knobs: a constant fitted from this machine's measured runs beats a
+    # hand-typed one. Disarmed (env var unset): nothing changes.
+    try:
+        from apex_tpu.monitor import calibrate as _calibrate
+
+        cal = _calibrate.active()
+    except Exception:  # noqa: BLE001 - calibration is best-effort
+        cal = None
+    if cal:
+        cf = cal.get("peak_flops")
+        if isinstance(cf, (int, float)) and cf > 0:
+            flops, src_f = float(cf), "calibrated"
+        cb = cal.get("peak_hbm_bytes_per_sec")
+        if isinstance(cb, (int, float)) and cb > 0:
+            bw, src_b = float(cb), "calibrated"
     source = src_f if src_f == src_b else f"flops:{src_f}|hbm:{src_b}"
     return {"platform": plat, "peak_flops": flops,
             "peak_hbm_bytes_per_sec": bw, "source": source}

@@ -446,6 +446,25 @@ def render(analysis: Dict[str, Any], file=None) -> None:
       + (", TRUNCATED final line" if analysis["truncated"] else "")
       + (f", {analysis['bad_lines']} bad line(s)" if analysis["bad_lines"] else "")
       + ")")
+    meta = analysis.get("meta")
+    if meta:
+        env = meta.get("env") or {}
+        bits = []
+        if meta.get("run"):
+            bits.append(f"run {meta['run']}")
+        if meta.get("fingerprint"):
+            bits.append(f"fingerprint {meta['fingerprint']}")
+        if env.get("git"):
+            bits.append(f"git {env['git']}")
+        if env.get("jax"):
+            bits.append(f"jax {env['jax']}")
+        if env.get("device_platform"):
+            bits.append(env["device_platform"])
+        if env.get("peak_overrides"):
+            bits.append("peak overrides "
+                        + ",".join(sorted(env["peak_overrides"])))
+        if bits:
+            p("meta: " + "  ".join(bits))
     tp = analysis.get("tokens_per_sec")
     if tp:
         p(f"throughput tok/s: p10 {tp['p10']}  p50 {tp['p50']}  "

@@ -29,6 +29,12 @@ that the monitor pieces stay importable and functional:
    per fresh argument shape;
 7. report: the analysis CLI summarizes a journal and the compare gate
    exits non-zero exactly on regression;
+7b. ledger (ISSUE 16): run-ledger appends round-trip through the
+   crash-tolerant reader (a torn final line still parses), trend groups
+   by config fingerprint, the N-run regress gate passes its own history
+   and exits non-zero on a seeded throughput drop, and a fitted
+   calibration file round-trips — armed via ``APEX_TPU_CALIBRATION`` it
+   outranks the ``APEX_TPU_PEAK_*`` env overrides in ``mfu.peak_spec``;
 8. lint: the source-invariant linter (``apex_tpu.lint``) reports the tree
    clean (all suppressions justified) and the trace analyzers reproduce
    the known hazards — the d=32/(sq,1) lane-padding numbers, the bare
@@ -875,6 +881,79 @@ def _check_audit() -> dict:
             "dense_peak_bytes": hbm["peak_bytes"]}
 
 
+def _check_ledger() -> dict:
+    """The run ledger + calibration loop (ISSUE 16): appends round-trip
+    through the crash-tolerant reader, trend groups by fingerprint, the
+    N-run regress gate passes its own history and exits non-zero on a
+    seeded throughput drop, and a fitted calibration file round-trips
+    and (armed) outranks the APEX_TPU_PEAK_* env overrides."""
+    import contextlib
+    import io
+    import shutil
+
+    from apex_tpu.monitor import calibrate, ledger
+
+    d = tempfile.mkdtemp(prefix="apex_tpu_ledger_")
+    try:
+        path = os.path.join(d, "ledger.jsonl")
+
+        def rec(rate):
+            return {"kind": "run", "run": "selftest",
+                    "config": {"tp": 2},
+                    "fingerprint": ledger.config_fingerprint({"tp": 2}),
+                    "measured": {"step_records": 4,
+                                 "tokens_per_sec": {"p50": rate},
+                                 "wall_s": {"p50": 0.1}},
+                    "predicted": {"flops_per_step": 2e11}}
+
+        for _ in range(3):
+            ledger.append(path, rec(1000.0))
+        rows = ledger.read(path)
+        tr = ledger.trend(rows)
+        assert len(tr) == 1 and len(next(iter(tr.values()))["rows"]) == 3, tr
+
+        # self-history passes; a seeded 30% throughput drop exits 1
+        assert ledger.regress(rows)["ok"]
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert ledger.main(["regress", path]) == 0
+        ledger.append(path, rec(700.0))
+        with contextlib.redirect_stdout(io.StringIO()):
+            assert ledger.main(["regress", path, "--format", "json"]) == 1
+        res = ledger.regress(ledger.read(path))
+        assert res["regressed"] == ["tokens_per_sec_p50"], res
+
+        # a ledger torn by a kill mid-write still parses (and flags it)
+        with open(path, "a") as f:
+            f.write('{"kind": "run", "torn')
+        rows = ledger.read(path)
+        assert len(rows) == 4 and rows.truncated, (len(rows), rows.truncated)
+
+        # calibrate: fit → save → armed file outranks the env knob
+        fit = calibrate.fit(rows)
+        assert fit["peak_flops"] == 2e12, fit  # 2e11 flops / 0.1 s
+        cal_path = calibrate.save(os.path.join(d, "cal.json"), fit)
+        saved = {k: os.environ.pop(k, None)
+                 for k in ("APEX_TPU_PEAK_FLOPS", calibrate.ENV_CALIBRATION)}
+        try:
+            os.environ["APEX_TPU_PEAK_FLOPS"] = "9e99"
+            os.environ[calibrate.ENV_CALIBRATION] = cal_path
+            from apex_tpu.monitor import mfu
+
+            spec = mfu.peak_spec("tpu v4")
+            assert spec["peak_flops"] == 2e12, spec
+            assert "calibrated" in spec["source"], spec
+        finally:
+            for k, v in saved.items():
+                os.environ.pop(k, None)
+                if v is not None:
+                    os.environ[k] = v
+        return {"ok": True, "runs": len(ledger.read(path)),
+                "regressed": res["regressed"],
+                "fitted_peak_flops": fit["peak_flops"]}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def run() -> dict:
     """In-process smoke (no platform mutation — safe under any backend)."""
     results = {}
@@ -887,6 +966,7 @@ def run() -> dict:
                      ("mfu", _check_mfu),
                      ("diagnose", _check_diagnose),
                      ("report", _check_report),
+                     ("ledger", _check_ledger),
                      ("lint", _check_lint),
                      ("audit", _check_audit),
                      ("tracing", _check_tracing),

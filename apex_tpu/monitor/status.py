@@ -63,6 +63,11 @@ def snapshot(
     meta = next((r for r in records if r.get("kind") == "meta"), None)
     if meta and meta.get("run"):
         out["run"] = meta["run"]
+    if meta and meta.get("fingerprint"):
+        out["fingerprint"] = meta["fingerprint"]
+        git = (meta.get("env") or {}).get("git")
+        if git:
+            out["git"] = git
     if recent:
         last = recent[-1]
         out["last_step"] = last.get("step", last.get("window"))
@@ -154,6 +159,10 @@ def render(snap: Dict[str, Any], file=None) -> None:
     file = file or sys.stdout
     p = lambda *a: print(*a, file=file)  # noqa: E731
     head = f"run: {snap.get('run', '?')}  records: {snap['records']}"
+    if snap.get("fingerprint"):
+        head += f"  fingerprint {snap['fingerprint']}"
+    if snap.get("git"):
+        head += f"  git {snap['git']}"
     if snap.get("truncated"):
         head += "  [TRUNCATED TAIL]"
     p(head)

@@ -466,6 +466,18 @@ def ici_spec(platform: Optional[str] = None) -> Dict[str, Any]:
             bw, source = float(env) * 1e9, "env"
     except ValueError:
         pass  # malformed override: keep the table row
+    # an armed calibration file (APEX_TPU_CALIBRATION) outranks the env
+    # knob — same precedence as mfu.peak_spec; disarmed: unchanged
+    try:
+        from apex_tpu.monitor import calibrate as _calibrate
+
+        cal = _calibrate.active()
+    except Exception:  # noqa: BLE001 - calibration is best-effort
+        cal = None
+    if cal:
+        ci = cal.get("peak_ici_bytes_per_sec")
+        if isinstance(ci, (int, float)) and ci > 0:
+            bw, source = float(ci), "calibrated"
     return {"platform": plat, "ici_bytes_per_sec": bw, "source": source}
 
 

@@ -1539,6 +1539,34 @@ def main():
 
     if errors:
         result["errors"] = errors
+    # BENCH_LEDGER: one fingerprinted run record per bench round so the
+    # on-chip trajectory is tracked across sessions (monitor/ledger.py);
+    # stderr-only chatter — the stdout JSON line stays the contract
+    if os.environ.get("BENCH_LEDGER") or os.environ.get("APEX_TPU_LEDGER"):
+        try:
+            from apex_tpu.monitor import ledger as ledger_mod
+
+            lpath = (os.environ.get("BENCH_LEDGER")
+                     or os.environ["APEX_TPU_LEDGER"])
+            cfg = {"run": "bench", "batch": batch, "seq": seq,
+                   "steps": steps,
+                   "zero": os.environ.get("BENCH_ZERO", "0"),
+                   "qcomm": os.environ.get("BENCH_QCOMM", "none")}
+            measured = None
+            if not os.environ.get("BENCH_JOURNAL"):
+                measured = {"step_records": steps}
+                if isinstance(result.get("value"), (int, float)):
+                    measured["tokens_per_sec"] = {"p50": result["value"]}
+            rec = ledger_mod.append_run(
+                lpath, run="bench", config=cfg,
+                journal=os.environ.get("BENCH_JOURNAL"),
+                measured=measured,
+                extra={"metric": result.get("metric"),
+                       "vs_baseline": result.get("vs_baseline")})
+            print(f"ledger: {rec['fingerprint']} -> {lpath}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - never lose the record
+            print(f"ledger append failed: {e}", file=sys.stderr)
     print(json.dumps(result))
     sys.exit(0)
 
@@ -1623,6 +1651,10 @@ if __name__ == "__main__":
     # env) rings recent records for the crash dump
     if os.environ.get("BENCH_FLIGHT"):
         os.environ.setdefault("APEX_TPU_FLIGHT", os.environ["BENCH_FLIGHT"])
+    # BENCH_LEDGER rides the same env-mapping pattern: one spelling for
+    # the bench driver, the library knob for everything it spawns
+    if os.environ.get("BENCH_LEDGER"):
+        os.environ.setdefault("APEX_TPU_LEDGER", os.environ["BENCH_LEDGER"])
     if "--selftest" in sys.argv:
         print(json.dumps({"selftest": selftest()}))
     elif ("--gpt-headline" in sys.argv or "--gpt-degraded" in sys.argv

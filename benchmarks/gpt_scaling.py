@@ -538,13 +538,26 @@ _TABLE_NOTES = {
 
 
 def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
-             steps, output_dir=None, grid=GRID, big_rung=False):
+             steps, output_dir=None, grid=GRID, big_rung=False,
+             ledger=None):
     """Sweep ``grid`` × ``layers_list`` (the reference ramps layer counts per
     config, gpt_scaling_test.py:53-57). One JSON artifact per (config,
     layers) when ``output_dir`` is set, plus a combined ``scaling_table``;
     returns the result rows. ``big_rung=True`` appends the 2.7B-class
     :func:`placement_rung` row (analytic residency + full-shape gather
-    census) to the table."""
+    census) to the table. ``ledger`` appends one fingerprinted run
+    record per measured config row (apex_tpu.monitor.ledger) so sweep
+    trajectories track across sessions."""
+    def ledger_row(res):
+        if not ledger:
+            return
+        try:
+            from apex_tpu.monitor import ledger as ledger_mod
+
+            ledger_mod.append_scaling_row(ledger, res)
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a sweep
+            print(f"ledger append failed: {e}", flush=True)
+
     rows = []
     for entry in grid:
         dp, tp, pp = entry[:3]
@@ -610,6 +623,7 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             if eff != layers:
                 res["config"]["requested_layers"] = layers
             rows.append(res)
+            ledger_row(res)
             print(json.dumps(res), flush=True)
             if output_dir:
                 os.makedirs(output_dir, exist_ok=True)
@@ -693,14 +707,22 @@ def main():
     p.add_argument("--no-big-rung", action="store_true",
                    help="skip the 2.7B-class placement rung (analytic "
                         "residency + full-shape gather census)")
+    p.add_argument("--ledger", nargs="?", const="out/ledger.jsonl",
+                   default=None, metavar="PATH",
+                   help="append one fingerprinted run record per measured "
+                        "config row to the run ledger "
+                        "(apex_tpu.monitor.ledger); "
+                        "APEX_TPU_LEDGER=<path> arms it too")
     args = p.parse_args()
+    if not args.ledger and os.environ.get("APEX_TPU_LEDGER"):
+        args.ledger = os.environ["APEX_TPU_LEDGER"]
     run_grid(
         hidden=args.hidden,
         layers_list=[int(x) for x in args.layers.split(",")],
         heads=args.heads, vocab=args.vocab, seq=args.seq,
         micro_batch=args.micro_batch, n_micro=args.num_microbatches,
         steps=args.steps, output_dir=args.output_dir,
-        big_rung=not args.no_big_rung)
+        big_rung=not args.no_big_rung, ledger=args.ledger)
 
 
 if __name__ == "__main__":

@@ -54,6 +54,18 @@ def parse_args():
                         "at 1 B/elem + per-chunk fp32 scales, with an "
                         "error-feedback residual in the sharded state "
                         "(parallel/quantize.py)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="write a per-step JSON-lines metrics journal "
+                        "(apex_tpu.monitor: wall time, tokens/s, loss, "
+                        "loss-scale state, HBM samples, online health "
+                        "alerts); adds one loss fetch per step")
+    p.add_argument("--ledger", nargs="?", const="out/ledger.jsonl",
+                   default=None, metavar="PATH",
+                   help="append one fingerprinted run record (config + "
+                        "environment stamp + measured rollup + predicted "
+                        "block) to the run ledger "
+                        "(apex_tpu.monitor.ledger); "
+                        "APEX_TPU_LEDGER=<path> arms it too")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="write a span trace (apex_tpu.monitor.tracing): "
                         "one barriered span per step plus a Chrome "
@@ -65,6 +77,8 @@ def parse_args():
                         "strict JSON on crash/SIGTERM/watchdog kill. "
                         "Default PATH: out/pretrain_bert.flight.json")
     args = p.parse_args()
+    if not args.ledger and os.environ.get("APEX_TPU_LEDGER"):
+        args.ledger = os.environ["APEX_TPU_LEDGER"]
     if args.flight == "auto":
         args.flight = "out/pretrain_bert.flight.json"
     if args.zero_level is not None:
@@ -213,10 +227,50 @@ def main():
         flight_mod.arm(args.flight,
                        meta={"run": "pretrain_bert",
                              "zero_level": args.zero_level or 0})
+    # one config dict for the journal's kind="meta" header AND the
+    # ledger record's fingerprinted config block
+    run_config = {"run": "pretrain_bert", "hidden": args.hidden,
+                  "layers": args.layers, "seq": args.seq,
+                  "batch": args.batch, "opt_level": args.opt_level,
+                  "zero": bool(args.zero),
+                  "zero_level": args.zero_level or 0,
+                  "reduce_dtype": args.reduce_dtype or "fp32"}
+    ledger_pred = {}
+    journal = None
+    if args.journal:
+        from apex_tpu.monitor import MetricsJournal
+        from apex_tpu.monitor import mfu as mfu_lib
+        from apex_tpu.monitor.health import HealthMonitor
+
+        journal = MetricsJournal(args.journal, sample_hbm_every=10,
+                                 meta=run_config, health=HealthMonitor())
+        try:
+            # one extra trace (no compile) arms per-step MFU/anatomy
+            # fields and fills the ledger's predicted block
+            from apex_tpu.monitor import comm_accounting
+
+            probe = synthetic_batch(np.random.default_rng(1), args.batch,
+                                    args.seq, cfg.vocab_size)
+            with comm_accounting() as acct:
+                costs = mfu_lib.traced_step_costs(
+                    train_step, params, state, *probe)
+            toks_per_step = args.batch * args.seq
+            journal.set_step_costs(
+                flops_per_token=costs["flops"] / toks_per_step,
+                bytes_per_token=costs["bytes"] / toks_per_step,
+                method=costs["method"])
+            journal.set_step_comm(acct.total_bytes())
+            ledger_pred.update(flops_per_step=costs["flops"],
+                               bytes_per_step=costs["bytes"],
+                               comm_bytes_per_step=acct.total_bytes())
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"mfu arming failed (journal continues without): {e}")
     rng = np.random.default_rng(0)
     t0 = time.perf_counter()
     for i in range(args.steps):
         batch = synthetic_batch(rng, args.batch, args.seq, cfg.vocab_size)
+        if journal is not None:
+            journal.step_start()
         if tracer is not None:
             from apex_tpu.monitor.tracing import maybe_span
 
@@ -227,6 +281,11 @@ def main():
                 sp.barrier(loss)
         else:
             params, state, loss, metrics = train_step(params, state, *batch)
+        if journal is not None:
+            # float(loss) inside step_end is the step's execution barrier
+            journal.step_end(step=i, loss=loss,
+                             tokens=args.batch * args.seq,
+                             metrics=metrics, scaler=state.scaler)
         if i == 0:
             float(loss)
             t0 = time.perf_counter()
@@ -246,10 +305,30 @@ def main():
         from apex_tpu.monitor import flight as flight_mod
 
         flight_mod.disarm()  # clean exit: restore hooks, no dump
+    if journal is not None:
+        journal.close()
     n = max(args.steps - 1, 1)
     dt = (time.perf_counter() - t0) / n
     print(f"{args.batch * args.seq / dt:.0f} tokens/s "
           f"({args.opt_level}, FusedLAMB, {dt*1e3:.1f} ms/step)")
+    if args.ledger:
+        try:
+            from apex_tpu.monitor import ledger as ledger_mod
+
+            measured = None
+            if not args.journal:
+                measured = {"step_records": args.steps,
+                            "tokens_per_sec":
+                                {"p50": round(args.batch * args.seq / dt, 1)},
+                            "wall_s": {"p50": round(dt, 6)},
+                            "loss": {"last": float(loss)}}
+            rec = ledger_mod.append_run(
+                args.ledger, run="pretrain_bert", config=run_config,
+                journal=args.journal, measured=measured,
+                predicted=ledger_pred)
+            print(f"ledger: {rec['fingerprint']} -> {args.ledger}")
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"ledger append failed: {e}")
 
 
 if __name__ == "__main__":

@@ -118,7 +118,16 @@ def parse_args():
                         "slo-burn health rule gates attainment)")
     p.add_argument("--slo-itl-ms", type=float, default=None,
                    help="ITL target in ms (see --slo-ttft-ms)")
+    p.add_argument("--ledger", nargs="?", const="out/ledger.jsonl",
+                   default=None, metavar="PATH",
+                   help="append one fingerprinted run record (serve "
+                        "config + environment stamp + measured TTFT/ITL "
+                        "rollup) to the run ledger "
+                        "(apex_tpu.monitor.ledger); "
+                        "APEX_TPU_LEDGER=<path> arms it too")
     args = p.parse_args()
+    if not args.ledger and os.environ.get("APEX_TPU_LEDGER"):
+        args.ledger = os.environ["APEX_TPU_LEDGER"]
     if args.flight == "auto":
         args.flight = ((args.journal + ".flight.json") if args.journal
                        else "out/generate_gpt.flight.json")
@@ -172,6 +181,15 @@ def main():
 
         tracer = tracing.arm(args.trace,
                              meta={"run": "generate_gpt", "tp": args.tp})
+    # one serve-config dict for the journal's kind="meta" header AND the
+    # ledger record's fingerprinted config block
+    run_config = {"run": "generate_gpt", "tp": args.tp,
+                  "max_batch": args.max_batch, "max_seq": args.max_seq,
+                  "block_size": args.block_size,
+                  "window": args.window or 0,
+                  "prefix_cache": bool(args.prefix_cache),
+                  "prefill_chunk": args.prefill_chunk or 0,
+                  "spec_k": args.spec_k or 0}
     journal = None
     if args.journal:
         from apex_tpu.monitor import MetricsJournal
@@ -179,10 +197,7 @@ def main():
 
         journal = MetricsJournal(
             args.journal,
-            meta={"run": "generate_gpt", "tp": args.tp,
-                  "max_batch": args.max_batch, "max_seq": args.max_seq,
-                  "block_size": args.block_size,
-                  "window": args.window or 0},
+            meta=run_config,
             # stream every tick/request/slo record through the online
             # health rules; alerts land in this journal
             health=HealthMonitor())
@@ -234,6 +249,32 @@ def main():
 
     if journal is not None:
         journal.close()
+    if args.ledger:
+        try:
+            from apex_tpu.monitor import ledger as ledger_mod
+
+            measured = None
+            if not args.journal:
+                # journal-less serve: a minimal measured block in the
+                # report-rollup key shapes (serving section percentiles)
+                ttfts = sorted(1e3 * r.ttft_s for r in results.values())
+                itls = sorted(1e3 * s for r in results.values()
+                              for s in r.itl_s)
+                mid = lambda xs: xs[len(xs) // 2] if xs else None  # noqa: E731
+                serving = {"requests": len(results)}
+                if ttfts:
+                    serving["ttft_ms"] = {"p50": round(mid(ttfts), 3)}
+                if itls:
+                    serving["itl_ms"] = {"p50": round(mid(itls), 3)}
+                measured = {"step_records": engine.ticks,
+                            "serving": serving}
+            rec = ledger_mod.append_run(
+                args.ledger, run="generate_gpt", config=run_config,
+                journal=args.journal, measured=measured,
+                extra={"ticks": engine.ticks})
+            print(f"ledger: {rec['fingerprint']} -> {args.ledger}")
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"ledger append failed: {e}")
     if args.flight:
         from apex_tpu.monitor import flight as flight_mod
 

@@ -145,6 +145,15 @@ def parse_args():
                         "tick drive measuring per-rank bubble fraction "
                         "(pp>1, tp=1), and a Chrome trace-event export "
                         "next to PATH (chrome://tracing / Perfetto)")
+    p.add_argument("--ledger", nargs="?", const="out/ledger.jsonl",
+                   default=None, metavar="PATH",
+                   help="append one fingerprinted run record (config + "
+                        "environment stamp + measured rollup + predicted "
+                        "block) to the run ledger "
+                        "(apex_tpu.monitor.ledger; analyze with `python "
+                        "-m apex_tpu.monitor.ledger "
+                        "{list,trend,regress,calibrate}`); "
+                        "APEX_TPU_LEDGER=<path> arms it too")
     p.add_argument("--flight", nargs="?", const="auto", default=None,
                    metavar="PATH",
                    help="arm the flight recorder (apex_tpu.monitor."
@@ -155,6 +164,8 @@ def parse_args():
                         "last loss-scale state. Default PATH: "
                         "<journal>.flight.json")
     args = p.parse_args()
+    if not args.ledger and os.environ.get("APEX_TPU_LEDGER"):
+        args.ledger = os.environ["APEX_TPU_LEDGER"]
     if args.flight == "auto":
         args.flight = ((args.journal + ".flight.json") if args.journal
                        else "out/pretrain_gpt.flight.json")
@@ -424,6 +435,20 @@ def main():
         start = step
         print(f"resumed from step {step}")
 
+    # one config dict, two consumers: the journal's kind="meta" header
+    # and the ledger record's fingerprinted config block (same knobs →
+    # same fingerprint, so journal and ledger join trivially)
+    run_config = {"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
+                  "dp": dp, "hidden": args.hidden, "layers": args.layers,
+                  "seq": args.seq, "batch": batch,
+                  "schedule": args.pp_schedule, "vpp": args.vpp,
+                  "unroll": bool(args.unroll), "zero": bool(args.zero),
+                  "zero_level": args.zero_level or 0,
+                  "zero3_prefetch": args.zero3_prefetch or 0,
+                  "reduce_dtype": args.reduce_dtype or "fp32",
+                  "moe_experts": args.moe_experts or 0,
+                  "moe_dispatch_dtype": args.moe_dispatch_dtype or "none"}
+    ledger_pred = {}  # predicted block, filled at arm time (off-TPU math)
     journal = forensics = None
     if args.journal:
         from apex_tpu.monitor import (
@@ -437,13 +462,7 @@ def main():
 
         journal = MetricsJournal(
             args.journal, sample_hbm_every=10,
-            meta={"run": "pretrain_gpt", "tp": args.tp, "pp": args.pp,
-                  "dp": dp, "hidden": args.hidden, "layers": args.layers,
-                  "seq": args.seq, "batch": batch, "zero": bool(args.zero),
-                  "zero_level": args.zero_level or 0,
-                  "reduce_dtype": args.reduce_dtype or "fp32",
-                  "moe_experts": args.moe_experts or 0,
-                  "moe_dispatch_dtype": args.moe_dispatch_dtype or "none"},
+            meta=run_config,
             # online health rules (monitor/health.py): every record
             # streams through the detectors; kind="alert" rows land in
             # this same journal for report's alerts section and the
@@ -486,6 +505,10 @@ def main():
                 bytes_per_token=costs["bytes"] / (batch * args.seq),
                 method=costs["method"])
             journal.set_step_comm(acct.total_bytes())
+            # the same statics ARE the ledger's predicted block
+            ledger_pred.update(flops_per_step=costs["flops"],
+                               bytes_per_step=costs["bytes"],
+                               comm_bytes_per_step=acct.total_bytes())
         except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
             print(f"mfu arming failed (journal continues without): {e}")
         train_step = RecompileTracker(journal).wrap(train_step,
@@ -540,6 +563,8 @@ def main():
                 journal.set_bubble_fraction(
                     anatomy["bubble_fraction"]["mean"],
                     anatomy["expected_bubble_fraction"])
+            ledger_pred.setdefault(
+                "bubble_floor", anatomy["expected_bubble_fraction"])
         except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
             print(f"bubble probe failed (run continues without): {e}")
 
@@ -594,6 +619,26 @@ def main():
     dt = (time.perf_counter() - t0) / n_done
     print(f"{batch * args.seq / dt:.0f} tokens/s | mesh: tp={args.tp} pp={args.pp} "
           f"dp={dp} | {dt * 1e3:.1f} ms/step")
+    if args.ledger:
+        try:
+            from apex_tpu.monitor import ledger as ledger_mod
+
+            # journal-less runs still ledger: a minimal measured block
+            # in the report-rollup key shapes regress/trend read
+            measured = None
+            if not args.journal:
+                measured = {"step_records": args.steps,
+                            "tokens_per_sec":
+                                {"p50": round(batch * args.seq / dt, 1)},
+                            "wall_s": {"p50": round(dt, 6)},
+                            "loss": {"last": float(loss)}}
+            rec = ledger_mod.append_run(
+                args.ledger, run="pretrain_gpt", config=run_config,
+                journal=args.journal, measured=measured,
+                predicted=ledger_pred)
+            print(f"ledger: {rec['fingerprint']} -> {args.ledger}")
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill a run
+            print(f"ledger append failed: {e}")
     mesh_lib.destroy_model_parallel()
 
 
