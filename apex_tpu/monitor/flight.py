@@ -73,6 +73,11 @@ _LAST_STAGE: str = ""
 # cached child-side heartbeat writer: None = unchecked, False = no env
 _HB: Any = None
 
+#: zero-arg callable returning the in-flight request table (serve/
+#: engine.py registers its own around run()) — the crash dump names the
+#: REQUESTS a wedged serve was sitting on, not just the op
+_INFLIGHT_PROVIDER: Any = None
+
 
 def last_op() -> Optional[Dict[str, Any]]:
     """The most recent breadcrumb (``{"op", "ts"}``), or None."""
@@ -104,6 +109,15 @@ def reset_heartbeat_cache() -> None:
     re-exec paths that mutate ``APEX_TPU_HEARTBEAT_PATH``)."""
     global _HB
     _HB = None
+
+
+def set_inflight_provider(fn) -> None:
+    """Register (or clear, with None) the zero-arg callable whose return
+    value lands in crash dumps as ``inflight_requests`` — the serving
+    engine's in-flight request table (ISSUE 17). Host-side only; the
+    provider is called guarded at dump time, never during serving."""
+    global _INFLIGHT_PROVIDER
+    _INFLIGHT_PROVIDER = fn
 
 
 def breadcrumb(op: str, **attrs) -> None:
@@ -198,6 +212,11 @@ class FlightRecorder:
             payload["hbm"] = live_array_stats()
         except Exception:  # noqa: BLE001 - no backend / wedged backend
             payload["hbm"] = None
+        if _INFLIGHT_PROVIDER is not None:
+            try:
+                payload["inflight_requests"] = _INFLIGHT_PROVIDER()
+            except Exception:  # noqa: BLE001 - a bad provider must not
+                payload["inflight_requests"] = None  # spoil the dump
         payload["ring"] = [_to_host(r) for r in self.ring]
         bad: list = []
         payload = _sanitize_nonfinite(payload, "", bad)
@@ -277,11 +296,12 @@ def disarm() -> None:
     breadcrumb state — a later arm in the same process must not
     attribute its crashes to an operation from a previous segment."""
     global _GLOBAL, _ENV_CHECKED, _PREV_EXCEPTHOOK, _PREV_SIGTERM
-    global _LAST_OP, _LAST_STAGE
+    global _LAST_OP, _LAST_STAGE, _INFLIGHT_PROVIDER
     _GLOBAL = None
     _ENV_CHECKED = True
     _LAST_OP = None
     _LAST_STAGE = ""
+    _INFLIGHT_PROVIDER = None
     if sys.excepthook is _flight_excepthook:
         sys.excepthook = _PREV_EXCEPTHOOK or sys.__excepthook__
         _PREV_EXCEPTHOOK = None
@@ -372,6 +392,6 @@ def write_kill_dump(path: str, *, reason: str, status: str,
 __all__ = [
     "FlightRecorder", "arm", "disarm", "get_recorder", "armed", "dump",
     "breadcrumb", "observe_record", "last_op", "set_stage", "load",
-    "write_kill_dump", "reset_heartbeat_cache", "ENV_FLIGHT",
-    "DEFAULT_CAPACITY",
+    "write_kill_dump", "reset_heartbeat_cache", "set_inflight_provider",
+    "ENV_FLIGHT", "DEFAULT_CAPACITY",
 ]

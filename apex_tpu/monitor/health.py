@@ -247,10 +247,15 @@ class HealthMonitor:
                   else _num(rec.get("target")))
         if att is None or target is None or att >= target:
             return []
+        # ISSUE 17: the engine stamps the window's dominant latency phase
+        # on its slo records — name it, so the alert says WHERE the burn
+        # came from ("slo-burn: queue-dominated")
+        dom = rec.get("dominant_phase")
+        prefix = f"{dom}-dominated: " if isinstance(dom, str) and dom else ""
         a = self._fire("slo-burn", step=rec.get("window"), value=att,
                        baseline=target,
-                       message=f"SLO attainment {att:.3f} below target "
-                               f"{target:.3f} this window")
+                       message=f"{prefix}SLO attainment {att:.3f} below "
+                               f"target {target:.3f} this window")
         return [a] if a else []
 
     def summary(self) -> Dict[str, Any]:

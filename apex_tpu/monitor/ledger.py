@@ -357,6 +357,13 @@ GATES: Tuple[Tuple[str, Tuple[str, ...], str, float], ...] = (
     ("prefix_hit_rate", ("serving", "prefix_hit_rate"), "drop", 0.0),
     ("accepted_len_p50", ("serving", "accepted_len", "p50"), "drop", 0.0),
     ("slo_attainment_p50", ("slo", "attainment", "p50"), "drop", 0.0),
+    # ISSUE 17: TTFT/ITL attribution drift — the queue share of each
+    # latency class must not grow across runs (report.compare's
+    # queue-fraction gate, pointed at the ledger history)
+    ("ttft_queue_frac",
+     ("serving", "attribution", "ttft", "queue_frac"), "grow", 0.05),
+    ("itl_queue_frac",
+     ("serving", "attribution", "itl", "queue_frac"), "grow", 0.05),
 )
 
 

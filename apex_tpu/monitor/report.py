@@ -70,6 +70,43 @@ def _dist_tail(vals: List[float]) -> Dict[str, float]:
     return out
 
 
+def attribution_rollup(rows: Sequence[Any]) -> Dict[str, Any]:
+    """Aggregate per-request ``attribution`` dicts (the reqtrace shape:
+    ``{"ttft": {...}, "itl": {...}}`` fraction dicts, each summing to
+    1.0) into one wall-weighted rollup per class whose fractions STILL
+    sum to 1.0 — the last sorted key absorbs the rounding residue, the
+    same discipline :func:`apex_tpu.serve.reqtrace.attribution_fractions`
+    applies per request. Lives here (not in serve) so journal analysis
+    stays jax-free."""
+    out: Dict[str, Any] = {}
+    for cls in ("ttft", "itl"):
+        frs = [(r.get(cls) or {}) for r in rows if isinstance(r, dict)]
+        frs = [f for f in frs
+               if isinstance(f.get("wall_s"), (int, float))
+               and f["wall_s"] > 0]
+        if not frs:
+            continue
+        walls = [float(f["wall_s"]) for f in frs]
+        keys = sorted({k for f in frs for k in f if k.endswith("_frac")})
+        if not keys:
+            continue
+        sums = {k: sum(float(f.get(k) or 0.0) * w
+                       for f, w in zip(frs, walls)) for k in keys}
+        norm = sum(sums.values()) or 1.0
+        row: Dict[str, Any] = {
+            "n": len(frs),
+            "wall_s_mean": round(sum(walls) / len(walls), 6),
+        }
+        acc = 0.0
+        for k in keys[:-1]:
+            v = round(sums[k] / norm, 4)
+            row[k] = v
+            acc += v
+        row[keys[-1]] = round(max(1.0 - acc, 0.0), 4)
+        out[cls] = row
+    return out
+
+
 def _lstsq_slope(ys: List[float]) -> float:
     """Least-squares slope of ys over their indices (trend per record)."""
     n = len(ys)
@@ -362,6 +399,12 @@ def analyze(
                if isinstance(r.get("accepted_len"), (int, float))]
         if acc:
             sv["accepted_len"] = _dist(acc)
+        # ISSUE 17: TTFT/ITL decomposed into queue / prefill-serialization
+        # / compute / barrier fractions (wall-weighted over the request
+        # records' per-request attribution dicts; each class sums to 1.0)
+        attr = attribution_rollup([r.get("attribution") for r in reqs])
+        if attr:
+            sv["attribution"] = attr
         out["serving"] = sv
 
     # serve SLO windows (kind="slo" records from serve.Engine when
@@ -560,6 +603,15 @@ def render(analysis: Dict[str, Any], file=None) -> None:
             parts.append(f"accepted draft len p50 "
                          f"{sv['accepted_len']['p50']}")
         p("serving: " + "; ".join(parts))
+        attr = sv.get("attribution") or {}
+        for cls in ("ttft", "itl"):
+            row = attr.get(cls)
+            if row:
+                fr = ", ".join(
+                    f"{k[:-5]} {row[k]}" for k in sorted(row)
+                    if k.endswith("_frac"))
+                p(f"  {cls} attribution (n={row['n']}, "
+                  f"wall mean {row['wall_s_mean']}s): {fr}")
     slo = analysis.get("slo")
     if slo:
         att = slo.get("attainment") or {}
@@ -660,7 +712,13 @@ def compare(
     chunked prefill exists to remove lives in the tail), and the prefix
     hit-rate / mean accepted draft length (``kind="prefill"`` and step
     ``accepted_len`` stamps) must not DROP — the same
-    :func:`must_not_drop` predicate throughput uses.
+    :func:`must_not_drop` predicate throughput uses. ISSUE 17 adds the
+    attribution gates (``ttft_queue_frac``/``itl_queue_frac`` must not
+    grow — the queue share of each latency class, from the request
+    records' per-request attribution) and degrades the mixed serve/train
+    pair gracefully: when exactly one journal has serving records and
+    the other is a train journal, the serving gates are skipped with a
+    note instead of failing.
 
     ``max_alerts`` (off by default) arms the health-alert gate: the
     candidate's derived alert count (``monitor/health.py`` rules replayed
@@ -776,6 +834,22 @@ def compare(
     # absolute slack keeps tiny off-TPU runs from gating on timer noise.
     sva = ra.get("serving") or {}
     svb = rb.get("serving") or {}
+    # mixed serve/train pair (ISSUE 17 satellite): when exactly one side
+    # served and the serve-less side is a TRAIN journal (it has loss
+    # records — a crashed serve candidate has neither), the pair is mixed
+    # on purpose; note it and skip the serving gates instead of erroring
+    # or failing the crash guard below
+    if bool(sva.get("requests")) != bool(svb.get("requests")):
+        other = rb if sva.get("requests") else ra
+        which = "b" if sva.get("requests") else "a"
+        if ((other.get("loss") or {}).get("first")) is not None:
+            checks.append({
+                "check": "serve_requests",
+                "a": sva.get("requests", 0), "b": svb.get("requests", 0),
+                "regressed": False,
+                "skipped": f"no serving records in {which} (train journal)",
+            })
+            sva, svb = {}, {}  # every serving check below skips on None
     # a candidate that served NOTHING has no "serving" section at all —
     # default its count to 0 (not None, which would skip the check and
     # sail a crashed candidate through green) whenever A served requests
@@ -812,6 +886,18 @@ def compare(
           (sva.get("accepted_len") or {}).get("p50"),
           (svb.get("accepted_len") or {}).get("p50"),
           worse=must_not_drop(threshold))
+    # latency ATTRIBUTION gates (ISSUE 17): the queue fraction of each
+    # request class must not GROW — a candidate whose TTFT held steady by
+    # trading compute for admission wait is a scheduling regression the
+    # raw percentiles can hide. Same predicate family; the 0.05 absolute
+    # slack covers near-zero-queue baselines.
+    for cls in ("ttft", "itl"):
+        check(f"{cls}_queue_frac",
+              ((sva.get("attribution") or {}).get(cls) or {}).get(
+                  "queue_frac"),
+              ((svb.get("attribution") or {}).get(cls) or {}).get(
+                  "queue_frac"),
+              worse=must_not_grow(threshold, slack=0.05))
     # serve SLO attainment (kind="slo" window records): the fraction of
     # tokens inside their latency targets must not DROP — the serving
     # health twin of the throughput gate

@@ -853,6 +853,81 @@ def _check_serve() -> dict:
             "spec_accepted_mean": eng2.stats["mean_accepted_len"]}
 
 
+def _check_reqtrace() -> dict:
+    """Request-scoped serving traces (ISSUE 17): every SLO violator keeps
+    its full span tree, compliant requests sample deterministically 1-in-N
+    with the rest folding into ONE bounded reqhist record, per-request
+    TTFT/ITL attribution fractions sum to 1.0, and a disarmed engine
+    produces identical token streams (the byte-identity discipline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.monitor import tracing
+    from apex_tpu.serve import Engine, Request, ServeConfig
+
+    cfg = GPTConfig(vocab_size=41, hidden_size=16, num_layers=1,
+                    num_attention_heads=2, max_seq_len=32,
+                    hidden_dropout=0.0, axis=None,
+                    compute_dtype=jnp.float32, remat=False)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    scfg = dict(max_batch=2, max_seq=24, block_size=8)
+
+    def reqs():
+        return [Request(prompt=[3, 1, 4, 1, 5], max_new_tokens=4,
+                        request_id="a"),
+                Request(prompt=[2, 7], max_new_tokens=3, request_id="b"),
+                Request(prompt=[6, 2, 8], max_new_tokens=3,
+                        request_id="c")]
+
+    def frac_sums(req):
+        for cls in ("ttft", "itl"):
+            fr = (req.attribution or {}).get(cls)
+            if fr:
+                s = sum(v for k, v in fr.items() if k.endswith("_frac"))
+                assert abs(s - 1.0) < 1e-3, (req.request_id, cls, fr)
+
+    # tail sampling keeps 100% of violators even at a huge sample stride
+    eng = Engine(model, params,
+                 ServeConfig(slo_itl_ms=1e-6, trace_sample_n=10 ** 6,
+                             **scfg))
+    tr = tracing.Tracer(None, keep=True)
+    with tracing.scoped(tr):
+        res = eng.run(reqs())
+    roots = [r for r in tr.records if r.get("name") == "serve.request"]
+    assert len(roots) == 3 and eng.trace_violators == 3, (
+        len(roots), eng.trace_violators)
+    kids = [r for r in tr.records
+            if r.get("cat") == "serve-req" and r.get("depth") == 1]
+    assert kids and all(r.get("request") for r in kids), kids[:2]
+    for req in res.values():
+        assert (req.trace or {}).get("trace_id"), req.request_id
+        frac_sums(req)
+
+    # compliant requests: deterministic 1-in-2 sample (= ceil(3/2) trees)
+    # + exactly one bounded histogram record for the rest
+    eng2 = Engine(model, params,
+                  ServeConfig(slo_itl_ms=1e9, trace_sample_n=2, **scfg))
+    tr2 = tracing.Tracer(None, keep=True)
+    with tracing.scoped(tr2):
+        eng2.run(reqs())
+    roots2 = [r for r in tr2.records if r.get("name") == "serve.request"]
+    hist = [r for r in tr2.records if r.get("kind") == "reqhist"]
+    assert len(roots2) == 2 and len(hist) == 1, (len(roots2), len(hist))
+    assert "ttft" in hist[0]["phases"], hist[0]["phases"].keys()
+
+    # disarmed: identical token streams, attribution still stamped
+    eng3 = Engine(model, params, ServeConfig(**scfg))
+    res3 = eng3.run(reqs())
+    assert all(res3[k].tokens == res[k].tokens for k in res3), "drift"
+    for req in res3.values():
+        frac_sums(req)
+    return {"ok": True, "violator_roots": len(roots),
+            "sampled_roots": len(roots2),
+            "hist_phases": len(hist[0]["phases"])}
+
+
 def _check_audit() -> dict:
     """The whole-program step-audit gate (ISSUE 13): every registered IR
     pass (collective-consistency / static-hbm / dtype-drift / comm-bytes)
@@ -970,7 +1045,8 @@ def run() -> dict:
                      ("lint", _check_lint),
                      ("audit", _check_audit),
                      ("tracing", _check_tracing),
-                     ("serve", _check_serve)):
+                     ("serve", _check_serve),
+                     ("reqtrace", _check_reqtrace)):
         try:
             results[name] = fn()
         except Exception as e:  # noqa: BLE001 - report, don't crash the gate

@@ -4,7 +4,9 @@ The report CLI judges a FINISHED journal; this one watches a LIVE run —
 it tails the journal (and optionally the structured heartbeat + flight
 dump next to it) into a one-screen refresh: step cadence and
 throughput, loss, loss-scale, HBM curve, pipeline bubble / overlap
-stamps, serve queue + SLO attainment, the last hang-attribution
+stamps, serve queue + SLO attainment + the worst in-flight request
+(age, phase, slot — the engine's ``worst_request`` step stamp), the
+last hang-attribution
 breadcrumb, and the recent alert feed (``monitor/health.py`` rules
 replayed over the tail, plus any ``kind="alert"`` rows an armed monitor
 journaled live).
@@ -90,6 +92,13 @@ def snapshot(
                     if isinstance(r.get(key), (int, float))]
             if vals:
                 out[key] = vals[-1]
+        # worst in-flight request (ISSUE 17): the newest decode tick's
+        # oldest request — {id, age_s, phase, slot}, stamped by the serve
+        # engine only while requests are in flight
+        worst = [r["worst_request"] for r in recent
+                 if isinstance(r.get("worst_request"), dict)]
+        if worst:
+            out["worst_request"] = worst[-1]
     # HBM: newest sample from step sub-dicts or standalone hbm rows
     hbm = []
     for r in records:
@@ -204,6 +213,11 @@ def render(snap: Dict[str, Any], file=None) -> None:
                      else ""))
         if slo.get("goodput_tokens_per_sec") is not None:
             sv.append(f"goodput {slo['goodput_tokens_per_sec']} tok/s")
+    wr = snap.get("worst_request")
+    if isinstance(wr, dict):
+        sv.append(f"worst req {wr.get('id')} "
+                  f"({wr.get('phase')}, slot {wr.get('slot')}, "
+                  f"{wr.get('age_s')}s old)")
     if sv:
         p("serve: " + "  ".join(sv))
     hb = snap.get("heartbeat")

@@ -678,19 +678,30 @@ _TRACKS = {"pipe": 0, "compute": 0, "pipe-comm": 1, "comm": 1}
 def chrome_trace(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     """Convert span records to the Chrome trace-event JSON format:
     complete (``"ph": "X"``) events, one process row per rank, compute/
-    comm/host thread tracks. The dict round-trips ``json.dumps`` →
-    ``chrome://tracing`` / Perfetto load."""
+    comm/host thread tracks — plus one lane per sampled serving request
+    (spans carrying a ``request`` attr share a named thread). The dict
+    round-trips ``json.dumps`` → ``chrome://tracing`` / Perfetto load."""
     spans = _spans(records)
     if not spans:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     t0 = min(r.get("ts", 0.0) for r in spans)
     events: List[Dict[str, Any]] = []
     pids = set()
+    req_lanes: Dict[str, int] = {}
+    req_lane_pid: Dict[str, int] = {}
     for r in spans:
         pid = int(r.get("rank") or 0)
         pids.add(pid)
         cat = r.get("cat", "host")
-        tid = _TRACKS.get(cat, 2 + int(r.get("depth") or 0))
+        req = r.get("request")
+        if req is not None:
+            # request-scoped spans get a dedicated lane (tids >= 16 keep
+            # clear of the compute/comm/host depth tracks)
+            key = str(req)
+            tid = req_lanes.setdefault(key, 16 + len(req_lanes))
+            req_lane_pid.setdefault(key, pid)
+        else:
+            tid = _TRACKS.get(cat, 2 + int(r.get("depth") or 0))
         args = {k: v for k, v in r.items()
                 if k not in _CORE_FIELDS and v is not None}
         events.append({
@@ -703,6 +714,10 @@ def chrome_trace(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     for pid in sorted(pids):
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "args": {"name": f"rank {pid}"}})
+    for key, tid in req_lanes.items():
+        events.append({"ph": "M", "name": "thread_name",
+                       "pid": req_lane_pid[key], "tid": tid,
+                       "args": {"name": f"request {key}"}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -15,6 +15,11 @@ Pieces:
   or lane-pads — see the layout note there and PERF_NOTES r11);
 - :mod:`.scheduler` — :class:`ContinuousBatcher`: FIFO request queue over a
   fixed slot array, admission each tick, slot reuse after retirement;
+- :mod:`.reqtrace`  — request-scoped tracing (ISSUE 17): serializable
+  :class:`TraceContext` (id + parent span), per-request TTFT/ITL
+  attribution fractions that sum to 1.0, and the bounded
+  :class:`PhaseHistogram` that non-sampled requests fold into under
+  tail-based sampling;
 - :mod:`.sampler`   — greedy + temperature/top-k sampling with per-slot
   PRNG keys;
 - :mod:`.engine`    — :class:`Engine`: jitted shape-stable programs
@@ -34,5 +39,10 @@ from apex_tpu.serve.cache import (  # noqa: F401
     kv_cache_spec,
 )
 from apex_tpu.serve.engine import Engine, ServeConfig  # noqa: F401
+from apex_tpu.serve.reqtrace import (  # noqa: F401
+    PhaseHistogram,
+    TraceContext,
+    attribution_fractions,
+)
 from apex_tpu.serve.sampler import sample_tokens  # noqa: F401
 from apex_tpu.serve.scheduler import ContinuousBatcher, Request  # noqa: F401

@@ -48,6 +48,15 @@ same paged-cache layer, each shape-stable:
   output is bit-identical to the non-speculative engine. Greedy only
   (exact speculative SAMPLING needs rejection-sampling machinery the
   engine does not carry).
+
+Request-scoped tracing (ISSUE 17): every request carries a serializable
+trace context from submit through retire; the engine decomposes each
+TTFT/ITL wall into queue / prefill-serialization / compute / barrier
+fractions summing to 1.0 (serve/reqtrace.py, always-on host accounting)
+and — with a tracer armed — emits full span trees for SLO violators plus
+a deterministic 1-in-``trace_sample_n`` compliant sample, folding the
+rest into one bounded per-phase histogram record. Disarmed, the compiled
+programs are byte-identical.
 """
 
 from __future__ import annotations
@@ -71,6 +80,11 @@ from apex_tpu.serve.cache import (
     blocks_for,
     init_kv_cache,
     kv_cache_spec,
+)
+from apex_tpu.serve.reqtrace import (
+    PhaseHistogram,
+    TraceContext,
+    attribution_fractions,
 )
 from apex_tpu.serve.sampler import fold_tick, sample_tokens
 from apex_tpu.serve.scheduler import ContinuousBatcher, Request
@@ -122,6 +136,13 @@ class ServeConfig:
     slo_itl_ms: Optional[float] = None
     slo_window: int = 32        # engine ticks per SLO window record
     slo_target: float = 0.99    # attainment the slo-burn health rule gates
+    # -- request-scoped tracing (ISSUE 17) -----------------------------------
+    # tail-based sampling: with a tracer armed, every SLO violator's full
+    # span tree is emitted plus a deterministic 1-in-N sample of compliant
+    # retires; everything else folds into ONE bounded per-phase histogram
+    # record, so the trace stream stays flat under load. Host-side only —
+    # disarmed, the compiled programs are byte-identical (tier-1 pin).
+    trace_sample_n: int = 16
 
     def resolved(self) -> "ServeConfig":
         pf = self.prefill_len or self.max_seq
@@ -133,6 +154,8 @@ class ServeConfig:
         pc = self.prefill_chunk
         if pc is not None:
             pc = max(1, min(int(pc), pf))
+        if self.trace_sample_n < 1:
+            raise ValueError("trace_sample_n must be >= 1")
         if self.spec_k and self.temperature != 0.0:
             raise ValueError(
                 "spec_k > 0 requires temperature == 0: speculative "
@@ -280,6 +303,23 @@ class Engine:
         self._slo_t0 = time.perf_counter()
         self._slo_counts = {"ttft_total": 0, "ttft_within": 0,
                             "itl_total": 0, "itl_within": 0}
+        # -- request-scoped tracing state (ISSUE 17; host-side only) --------
+        # ITL attribution accumulators per slot (armed when a slot's first
+        # token lands). Attribution accounting is ALWAYS-ON — a handful of
+        # perf_counter reads per tick, never touching the compiled
+        # programs; span-event buffering (_req_event) is tracer-gated, so
+        # a disarmed engine keeps no per-request event state at all.
+        self._itl_acc: List[Optional[Dict[str, float]]] = [None] * B
+        self._tick_prefill_s = 0.0  # prefill seconds folded into THIS tick
+        self._req_events: Dict[Any, List[Dict[str, Any]]] = {}
+        self._req_hist = PhaseHistogram()
+        self._retired_compliant = 0
+        self.trace_requests = 0   # retired while a tracer was armed
+        self.trace_sampled = 0    # full span trees emitted
+        self.trace_violators = 0  # SLO violators among them (all sampled)
+        # per-window phase mix: the slo-burn alert's dominant phase
+        self._slo_phase_s = {"queue": 0.0, "prefill_serial": 0.0,
+                             "compute": 0.0, "barrier": 0.0}
         # any of the three features routes prefill through the chunk program
         self._chunk_armed = bool(cfg.prefix_cache or cfg.prefill_chunk
                                  or cfg.spec_k)
@@ -528,6 +568,10 @@ class Engine:
                 f"shrink prompt/max_new_tokens")
         if request.arrival_s is None:
             request.arrival_s = time.perf_counter()
+        if request.trace is None:
+            # serializable metadata only (id + parent span) — the seam a
+            # cross-worker KV handoff propagates (ROADMAP item 4)
+            request.trace = TraceContext.new(request.request_id).to_dict()
         self.batcher.submit(request)
 
     def decode_args(self, tick: int):
@@ -602,6 +646,10 @@ class Engine:
                      mean_accepted_len=(
                          round(self.accepted_total / self.accept_events, 4)
                          if self.accept_events else None))
+        if self.trace_requests:
+            s.update(trace_requests=self.trace_requests,
+                     trace_sampled=self.trace_sampled,
+                     trace_violators=self.trace_violators)
         return s
 
     def drop_prefix_cache(self) -> None:
@@ -678,6 +726,10 @@ class Engine:
                 self.cow_forks += 1
         if forks:
             self._cow_copy_many(forks)
+            req = self.batcher.slots[slot]
+            if req is not None:
+                self._req_event(req, "req.cow_fork", slot=slot,
+                                forks=len(forks))
 
     def _admit(self, journal) -> None:
         """Fill free slots from the queue; one shape-stable prefill each.
@@ -709,12 +761,18 @@ class Engine:
                 for s2, r2 in reversed(placements[i:]):
                     self.batcher.slots[s2] = None
                     self.batcher.queue.appendleft(r2)
+                    self._req_event(r2, "req.deferred", slot=s2,
+                                    reason="pool_pressure")
                 break
             self._slot_reserved[slot] = need
             self._reserved_blocks += need
             plen = len(req.prompt)
             self._write_cap[slot] = plen + req.max_new_tokens
             t_admit = time.perf_counter()
+            if req.arrival_s is not None:
+                q_s = t_admit - req.arrival_s
+                self._req_event(req, "req.queue", ts=time.time() - q_s,
+                                dur_s=q_s, slot=slot)
             if self._chunk_armed:
                 self._admit_chunked(slot, req, t_admit, journal)
                 continue
@@ -728,6 +786,7 @@ class Engine:
             prompt[0, :plen] = req.prompt
             from apex_tpu.monitor import tracing as tracing_mod
 
+            t_call = time.perf_counter()
             with tracing_mod.maybe_span(
                     tracing_mod.get_tracer(), "serve.prefill", cat="compute",
                     slot=slot, prompt_len=plen) as sp:
@@ -739,6 +798,7 @@ class Engine:
                     jnp.asarray(row), jnp.asarray(prompt),
                     jnp.asarray(plen, jnp.int32), self._base_keys[slot],
                     jnp.asarray(2 * self.ticks + 1, jnp.int32))
+                t_ret = time.perf_counter()
                 sp.barrier(tok)
             first = int(np.asarray(tok))  # device fetch = TTFT barrier
             t = time.perf_counter()
@@ -750,6 +810,23 @@ class Engine:
             self._last_token[slot] = first
             self._active[slot] = True
             self._last_tok_t[slot] = t
+            # a monolithic prefill is this stream's compute+barrier AND
+            # every other running stream's prefill-serialization stall
+            self._tick_prefill_s += t - t_call
+            self._note_ttft_attr(
+                req,
+                queue_s=(t_admit - req.arrival_s
+                         if req.arrival_s is not None else 0.0),
+                compute_s=t_ret - t_call, barrier_s=t - t_ret)
+            self._req_event(req, "req.prefill",
+                            ts=time.time() - (t - t_call),
+                            dur_s=t - t_call, slot=slot, prompt_len=plen,
+                            chunks=1)
+            self._req_event(req, "req.first_token_barrier",
+                            ts=time.time() - (t - t_ret),
+                            dur_s=t - t_ret, slot=slot)
+            self._itl_acc[slot] = {"wall": 0.0, "prefill": 0.0,
+                                   "compute": 0.0, "barrier": 0.0}
             if journal is not None:
                 journal.log({"kind": "prefill", "request_id": req.request_id,
                              "slot": slot, "prompt_len": plen,
@@ -766,6 +843,7 @@ class Engine:
         cached_blocks: List[int] = []
         n_cached = 0
         if self.prefix_cache is not None:
+            t_lookup = time.perf_counter()
             cached_blocks, n_cached = self.prefix_cache.lookup(req.prompt)
             # a fully-cached prompt still recomputes its LAST position:
             # the first generated token needs that position's logits —
@@ -773,6 +851,10 @@ class Engine:
             clipped = min(n_cached, plen - 1)
             self.prefix_cache.tokens_reused -= n_cached - clipped
             n_cached = clipped
+            self._req_event(req, "req.prefix_lookup",
+                            dur_s=time.perf_counter() - t_lookup,
+                            slot=slot, hit_tokens=n_cached,
+                            pages_shared=len(cached_blocks))
         req.cached_tokens = n_cached
         row = np.full((self._nb_per_seq,), NULL_BLOCK, np.int32)
         row[:len(cached_blocks)] = cached_blocks
@@ -784,6 +866,7 @@ class Engine:
             "queue_delay_s": (t_admit - req.arrival_s
                               if req.arrival_s is not None else None),
             "cow0": self.cow_forks,
+            "compute_s": 0.0, "barrier_s": 0.0,
         }
         if self.config.prefill_chunk is None:
             while slot in self._prefilling:
@@ -809,6 +892,7 @@ class Engine:
         from apex_tpu.monitor import tracing as tracing_mod
 
         final = pos + n >= plen
+        t_call = time.perf_counter()
         with tracing_mod.maybe_span(
                 tracing_mod.get_tracer(), "serve.prefill_chunk",
                 cat="compute", slot=slot, start=pos, n_valid=n) as sp:
@@ -825,13 +909,26 @@ class Engine:
                 self._dk_pages, self._dv_pages = self._draft_chunk_fn(
                     self.draft_params, self._dk_pages, self._dv_pages,
                     row, tokens, start, nv, self._base_keys[slot], tick)
+            t_ret = time.perf_counter()
             sp.barrier(tok if tok is not None else self._k_pages)
+        t_bar = time.perf_counter()
+        # one chunk = this stream's prefill compute/barrier AND every
+        # running stream's prefill-serialization share of the same tick
+        self._tick_prefill_s += t_bar - t_call
+        st["compute_s"] += t_ret - t_call
+        st["barrier_s"] += t_bar - t_ret
+        self._req_event(req, "req.prefill_chunk",
+                        ts=time.time() - (t_bar - t_call),
+                        dur_s=t_bar - t_call, slot=slot, start=pos,
+                        n_valid=n, final=final)
         st["pos"] = pos + n
         st["chunks"] += 1
         if not final:
             return
         first = int(np.asarray(tok))  # device fetch = TTFT barrier
         t = time.perf_counter()
+        st["barrier_s"] += t - t_bar
+        self._tick_prefill_s += t - t_bar
         del self._prefilling[slot]
         req.tokens.append(first)
         req.ttft_s = (t - req.arrival_s
@@ -841,6 +938,14 @@ class Engine:
         self._last_token[slot] = first
         self._active[slot] = True
         self._last_tok_t[slot] = t
+        self._note_ttft_attr(req, queue_s=st["queue_delay_s"] or 0.0,
+                             compute_s=st["compute_s"],
+                             barrier_s=st["barrier_s"])
+        self._req_event(req, "req.first_token_barrier",
+                        ts=time.time() - (t - t_ret), dur_s=t - t_ret,
+                        slot=slot)
+        self._itl_acc[slot] = {"wall": 0.0, "prefill": 0.0,
+                               "compute": 0.0, "barrier": 0.0}
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt, self._tables[slot])
         if journal is not None:
@@ -890,6 +995,7 @@ class Engine:
             self._last_tok_t[slot] = None
             self._write_cap[slot] = 0
             req.finished_s = now
+            self._finish_request_trace(req, slot, now)
             results[req.request_id] = req
             if journal is not None:
                 gen_s = (now - (req.arrival_s or now))
@@ -900,6 +1006,8 @@ class Engine:
                     "ttft_s": req.ttft_s,
                     "itl_s": [round(v, 6) for v in req.itl_s],
                     "e2e_s": round(gen_s, 6),
+                    "trace_id": (req.trace or {}).get("trace_id"),
+                    "attribution": req.attribution,
                 })
 
     # -- SLO window accounting (ISSUE 14) ------------------------------------
@@ -955,11 +1063,211 @@ class Engine:
                 # without an ITL target
                 rec["goodput_tokens_per_sec"] = round(
                     c["itl_within"] / elapsed, 1)
+            phases = {k: v for k, v in self._slo_phase_s.items() if v > 0}
+            if phases:
+                # where this window's request seconds went — the health
+                # rule names the burn's dominant phase ("queue-dominated")
+                rec["dominant_phase"] = max(phases, key=phases.get)
             journal.log(rec)
         self._slo_window_id += 1
         self._slo_t0 = now
         self._slo_counts = {"ttft_total": 0, "ttft_within": 0,
                             "itl_total": 0, "itl_within": 0}
+        self._slo_phase_s = {k: 0.0 for k in self._slo_phase_s}
+
+    # -- request-scoped tracing (ISSUE 17) -----------------------------------
+
+    @staticmethod
+    def _req_tracer():
+        from apex_tpu.monitor import tracing as tracing_mod
+
+        return tracing_mod.get_tracer()
+
+    def _req_event(self, req: Request, name: str, *, ts=None,
+                   dur_s: float = 0.0, **attrs) -> None:
+        """Buffer one span-tree event for ``req`` — only while a tracer is
+        armed (the tail-sampling decision lands at retire; disarmed, the
+        engine keeps no per-request event state at all)."""
+        if self._req_tracer() is None:
+            return
+        ev: Dict[str, Any] = {"name": name,
+                              "ts": time.time() if ts is None else ts,
+                              "dur_s": float(dur_s)}
+        ev.update(attrs)
+        self._req_events.setdefault(req.request_id, []).append(ev)
+
+    def _note_ttft_attr(self, req: Request, *, queue_s: float,
+                        compute_s: float, barrier_s: float) -> None:
+        """Decompose the request's TTFT wall into queue / compute /
+        barrier fractions; the residual — time seated but not running its
+        own prefill (interleaved decode ticks, other slots' chunks, host
+        work) — is the prefill-serialization bucket."""
+        wall = req.ttft_s
+        fr = attribution_fractions(
+            0.0 if wall is None else wall,
+            {"queue": queue_s, "compute": compute_s, "barrier": barrier_s},
+            residual="prefill_serial")
+        req.attribution = {"ttft": fr}
+        if fr is None:
+            return
+        ph = self._slo_phase_s
+        used = 0.0
+        for key, v in (("queue", queue_s), ("compute", compute_s),
+                       ("barrier", barrier_s)):
+            v = min(max(float(v or 0.0), 0.0), wall - used)
+            ph[key] += v
+            used += v
+        ph["prefill_serial"] += wall - used
+
+    def _note_itl_attr(self, slot: int, dt: float, *, prefill_s: float,
+                       compute_s: float, barrier_s: float) -> None:
+        """Fold one inter-token interval into the slot's ITL accumulator:
+        prefill work interleaved into the tick (a monolithic long-prompt
+        stall lands HERE for the running streams), the decode dispatch,
+        and the token-fetch barrier — clipped cumulatively to the
+        interval; the residual is queue/host time."""
+        acc = self._itl_acc[slot]
+        if acc is None or dt <= 0:
+            return
+        ph = self._slo_phase_s
+        used = 0.0
+        for key, wkey, v in (("prefill", "prefill_serial", prefill_s),
+                             ("compute", "compute", compute_s),
+                             ("barrier", "barrier", barrier_s)):
+            v = min(max(float(v), 0.0), dt - used)
+            acc[key] += v
+            ph[wkey] += v
+            used += v
+        acc["wall"] += dt
+        ph["queue"] += dt - used
+
+    def _slo_violated(self, req: Request) -> bool:
+        c = self.config
+        if (c.slo_ttft_ms is not None and req.ttft_s is not None
+                and 1e3 * req.ttft_s > c.slo_ttft_ms):
+            return True
+        if c.slo_itl_ms is not None:
+            return any(1e3 * v > c.slo_itl_ms for v in req.itl_s)
+        return False
+
+    def _finish_request_trace(self, req: Request, slot: int,
+                              now: float) -> None:
+        """Stamp the request's final attribution and apply tail-based
+        sampling: SLO violators and every Nth compliant retire (N =
+        ``trace_sample_n``, a deterministic retire-order counter) emit
+        their full span tree through the armed tracer; the rest fold into
+        the bounded per-phase histogram."""
+        acc = self._itl_acc[slot]
+        self._itl_acc[slot] = None
+        at = dict(req.attribution or {})
+        if acc is not None and acc["wall"] > 0:
+            at["itl"] = attribution_fractions(
+                acc["wall"],
+                {"prefill_serial": acc["prefill"],
+                 "compute": acc["compute"], "barrier": acc["barrier"]},
+                residual="queue")
+        req.attribution = at or None
+        tracer = self._req_tracer()
+        if tracer is None:
+            self._req_events.pop(req.request_id, None)
+            return
+        self.trace_requests += 1
+        if self._slo_violated(req):
+            self.trace_violators += 1
+            sampled, reason = True, "slo_violation"
+        else:
+            sampled = (self._retired_compliant
+                       % self.config.trace_sample_n == 0)
+            self._retired_compliant += 1
+            reason = "sample"
+        events = self._req_events.pop(req.request_id, [])
+        if sampled:
+            self.trace_sampled += 1
+            trace = req.trace or {}
+            tid = trace.get("trace_id", str(req.request_id))
+            e2e = max(now - (req.arrival_s if req.arrival_s is not None
+                             else now), 0.0)
+            tracer.record(
+                "serve.request", dur_s=e2e, cat="serve-req",
+                ts=time.time() - e2e, request=tid,
+                request_id=req.request_id,
+                parent_span=trace.get("parent_span"),
+                prompt_len=len(req.prompt), new_tokens=len(req.tokens),
+                ttft_s=req.ttft_s, sampled=reason,
+                attribution=req.attribution)
+            for ev in events:
+                tracer.record(ev.pop("name"), dur_s=ev.pop("dur_s"),
+                              ts=ev.pop("ts"), cat="serve-req", depth=1,
+                              request=tid, **ev)
+            return
+        h = self._req_hist
+        ta = at.get("ttft") or {}
+        if req.ttft_s is not None and req.ttft_s > 0:
+            h.add("ttft", req.ttft_s)
+            for phase in ("queue", "compute", "barrier", "prefill_serial"):
+                f = ta.get(f"{phase}_frac")
+                if isinstance(f, (int, float)):
+                    h.add(f"ttft_{phase}", f * req.ttft_s)
+        for v in req.itl_s:
+            h.add("itl", v)
+        if req.arrival_s is not None:
+            h.add("e2e", now - req.arrival_s)
+
+    def _flush_reqhist(self) -> None:
+        """Emit the folded non-sampled requests as ONE ``kind="reqhist"``
+        record (bounded: fixed bucket edges whatever the load)."""
+        tracer = self._req_tracer()
+        if tracer is None or self._req_hist.empty:
+            return
+        rec = self._req_hist.record()
+        rec.update(requests=self.trace_requests,
+                   sampled=self.trace_sampled,
+                   violators=self.trace_violators)
+        tracer.log(rec)
+        self._req_hist.reset()
+
+    def _worst_request(self, now: float) -> Optional[Dict[str, Any]]:
+        """The oldest in-flight request (queued, prefilling, or decoding)
+        — the live view's "what is the engine sitting on" stamp."""
+        worst = None  # (arrival_s, req, phase, slot)
+        for req in self.batcher.queue:
+            if req.arrival_s is not None and (
+                    worst is None or req.arrival_s < worst[0]):
+                worst = (req.arrival_s, req, "queued", None)
+        for slot, req in self.batcher.active.items():
+            phase = "prefill" if slot in self._prefilling else "decode"
+            if req.arrival_s is not None and (
+                    worst is None or req.arrival_s < worst[0]):
+                worst = (req.arrival_s, req, phase, slot)
+        if worst is None:
+            return None
+        arrival, req, phase, slot = worst
+        return {"id": req.request_id, "age_s": round(now - arrival, 4),
+                "phase": phase, "slot": slot}
+
+    def _inflight_table(self) -> List[Dict[str, Any]]:
+        """Every in-flight request, for the flight recorder's crash/stall
+        dump — a wedged serve names the REQUEST, not just the op."""
+        now = time.perf_counter()
+        rows: List[Dict[str, Any]] = []
+        for req in self.batcher.queue:
+            rows.append({
+                "id": req.request_id, "phase": "queued", "slot": None,
+                "age_s": (round(now - req.arrival_s, 4)
+                          if req.arrival_s is not None else None),
+                "new_tokens": len(req.tokens), "trace": req.trace})
+        for slot, req in self.batcher.active.items():
+            st = self._prefilling.get(slot)
+            rows.append({
+                "id": req.request_id,
+                "phase": "prefill" if st is not None else "decode",
+                "slot": slot,
+                "age_s": (round(now - req.arrival_s, 4)
+                          if req.arrival_s is not None else None),
+                "new_tokens": len(req.tokens),
+                "prefill_pos": None if st is None else st["pos"],
+                "trace": req.trace})
+        return rows
 
     def _decoding(self) -> Dict[int, Request]:
         """Seated slots that finished prefill and still owe tokens
@@ -982,29 +1290,47 @@ class Engine:
             journal.step_start()
         from apex_tpu.monitor import tracing as tracing_mod
 
+        t0 = time.perf_counter()
         with tracing_mod.maybe_span(
                 tracing_mod.get_tracer(), "serve.decode", cat="compute",
                 tick=self.ticks, active=len(active)) as sp:
             self._k_pages, self._v_pages, toks = self._decode_fn(
                 *self.decode_args(self.ticks))
+            t_ret = time.perf_counter()
             sp.barrier(toks)
         toks_host = np.asarray(toks)  # device fetch stops the clock
         t = time.perf_counter()
+        tick_prefill = self._tick_prefill_s
+        compute_s, barrier_s = t_ret - t0, t - t_ret
         for slot, req in active.items():
             tok = int(toks_host[slot])
             self._lengths[slot] += 1  # the fed token is now cached
             req.tokens.append(tok)
             self._last_token[slot] = tok
             if self._last_tok_t[slot] is not None:
-                req.itl_s.append(t - self._last_tok_t[slot])
-                self._slo_note_itl(req.itl_s[-1])
+                dt = t - self._last_tok_t[slot]
+                req.itl_s.append(dt)
+                self._slo_note_itl(dt)
+                self._note_itl_attr(slot, dt, prefill_s=tick_prefill,
+                                    compute_s=compute_s,
+                                    barrier_s=barrier_s)
+                self._req_event(req, "req.decode_tick",
+                                ts=time.time() - dt, dur_s=dt, slot=slot,
+                                tick=self.ticks,
+                                prefill_s=round(tick_prefill, 6),
+                                compute_s=round(compute_s, 6),
+                                barrier_s=round(barrier_s, 6))
             self._last_tok_t[slot] = t
         if journal is not None:
+            extra: Dict[str, Any] = {}
+            wr = self._worst_request(t)
+            if wr is not None:
+                extra["worst_request"] = wr
             journal.step_end(
                 step=self.ticks, tokens=len(active),
                 queue_depth=self.batcher.queue_depth,
                 active_slots=len(active),
-                slot_occupancy=round(self.batcher.occupancy, 4))
+                slot_occupancy=round(self.batcher.occupancy, 4), **extra)
 
     def _spec_tick(self, journal) -> None:
         """One speculative decode tick: draft proposes K-1 tokens (one
@@ -1025,6 +1351,7 @@ class Engine:
             journal.step_start()
         from apex_tpu.monitor import tracing as tracing_mod
 
+        t0 = time.perf_counter()
         with tracing_mod.maybe_span(
                 tracing_mod.get_tracer(), "serve.spec", cat="compute",
                 tick=self.ticks, active=len(active)) as sp:
@@ -1038,10 +1365,13 @@ class Engine:
             self._k_pages, self._v_pages, ys = self._verify_fn(
                 self.params, self._k_pages, self._v_pages,
                 tables, lengths, xs, act, caps)
+            t_ret = time.perf_counter()
             sp.barrier(ys)
         xs_h = np.asarray(xs)
         ys_h = np.asarray(ys)  # device fetch stops the clock
         t = time.perf_counter()
+        tick_prefill = self._tick_prefill_s
+        compute_s, barrier_s = t_ret - t0, t - t_ret
         accepted = []
         eos = self.config.eos_id
         for slot, req in active.items():
@@ -1063,18 +1393,32 @@ class Engine:
                 dt = t - self._last_tok_t[slot]
                 req.itl_s.extend([dt / a] * a)
                 self._slo_note_itl(dt / a, n=a)
+                self._note_itl_attr(slot, dt, prefill_s=tick_prefill,
+                                    compute_s=compute_s,
+                                    barrier_s=barrier_s)
+                self._req_event(req, "req.spec_commit",
+                                ts=time.time() - dt, dur_s=dt, slot=slot,
+                                tick=self.ticks, accepted=a,
+                                prefill_s=round(tick_prefill, 6),
+                                compute_s=round(compute_s, 6),
+                                barrier_s=round(barrier_s, 6))
             self._last_tok_t[slot] = t
             accepted.append(a)
         self.accepted_total += sum(accepted)
         self.accept_events += len(accepted)
         self.spec_ticks += 1
         if journal is not None:
+            extra: Dict[str, Any] = {}
+            wr = self._worst_request(t)
+            if wr is not None:
+                extra["worst_request"] = wr
             journal.step_end(
                 step=self.ticks, tokens=sum(accepted),
                 queue_depth=self.batcher.queue_depth,
                 active_slots=len(active),
                 slot_occupancy=round(self.batcher.occupancy, 4),
-                accepted_len=round(sum(accepted) / len(accepted), 4))
+                accepted_len=round(sum(accepted) / len(accepted), 4),
+                **extra)
 
     # -- the serving loop ---------------------------------------------------
 
@@ -1095,26 +1439,42 @@ class Engine:
             # construction — compile/idle time must not dilute goodput
             self._slo_t0 = time.perf_counter()
         results: Dict[Any, Request] = {}
-        while not self.batcher.idle:
-            if max_ticks is not None and self.ticks >= max_ticks:
-                break
-            self._admit(journal)
-            # a 1-token request is complete straight out of prefill
-            self._retire_finished(journal, results, time.perf_counter())
-            # one prefill chunk (if any slot is mid-prompt) rides along
-            # with the decode step — the long-prompt interleave
-            self._chunk_tick(journal)
-            if self.config.spec_k:
-                self._spec_tick(journal)
-            else:
-                self._decode_tick(journal)
-            self._retire_finished(journal, results, time.perf_counter())
-            self.ticks += 1
-            self._slo_tick(journal)
-            if on_tick is not None:
-                on_tick(self)
+        from apex_tpu.monitor import flight as flight_mod
+
+        # the flight recorder's crash/stall dump carries the in-flight
+        # request table while the loop runs (cleared on the way out)
+        flight_mod.set_inflight_provider(self._inflight_table)
+        try:
+            while not self.batcher.idle:
+                if max_ticks is not None and self.ticks >= max_ticks:
+                    break
+                self._tick_prefill_s = 0.0
+                self._admit(journal)
+                # a 1-token request is complete straight out of prefill
+                self._retire_finished(journal, results,
+                                      time.perf_counter())
+                # one prefill chunk (if any slot is mid-prompt) rides
+                # along with the decode step — the long-prompt interleave
+                self._chunk_tick(journal)
+                if self.config.spec_k:
+                    self._spec_tick(journal)
+                else:
+                    self._decode_tick(journal)
+                self._retire_finished(journal, results,
+                                      time.perf_counter())
+                self.ticks += 1
+                self._slo_tick(journal)
+                if on_tick is not None:
+                    on_tick(self)
+        finally:
+            flight_mod.set_inflight_provider(None)
         # flush the partial final window so short runs carry SLO rows too
         self._slo_tick(journal, force=True)
+        if self.batcher.idle:
+            # a drained run folds its non-sampled requests into ONE
+            # bounded histogram record (open-loop drivers call run() per
+            # tick — only the true end of serving emits)
+            self._flush_reqhist()
         return results
 
     # -- training-state import ---------------------------------------------

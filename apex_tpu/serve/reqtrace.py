@@ -1,0 +1,131 @@
+"""Request-scoped trace context, latency attribution, and tail sampling.
+
+Every request carries a serializable :class:`TraceContext` (id + parent
+span — plain dict metadata, the seam ROADMAP item 4's cross-worker KV
+handoff rides); the engine decomposes each request's TTFT and ITL walls
+into queue / prefill-serialization / compute / barrier fractions that
+sum to 1.0 (the per-request twin of ``tracing.step_anatomy``'s
+clip-and-residual discipline), and tail-based sampling keeps the full
+span tree for every SLO violator plus a deterministic 1-in-N compliant
+sample while the rest folds into the bounded :class:`PhaseHistogram`.
+
+Pure host-side stdlib — no jax import, safe for analysis consumers.
+
+No reference-file citation: NVIDIA Apex has no serving layer; this is
+the per-request observability that production serving systems pair with
+continuous batching (PAPERS.md: efficient operation fusion treats
+end-to-end request latency, not kernel time, as the objective).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import itertools
+from typing import Any, Dict, Mapping, Optional
+
+_ids = itertools.count()
+
+# Fixed log-spaced edges (seconds): 10 us .. ~84 s, x2 per bucket. One
+# shared table keeps every reqhist record the same bounded size.
+HIST_EDGES_S = tuple(round(1e-5 * (2.0 ** i), 9) for i in range(24))
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Serializable request trace context: an id plus the parent span it
+    hangs under. A context is plain metadata — ``to_dict``/``from_dict``
+    round-trip through JSON so it can cross process/worker boundaries."""
+
+    trace_id: str
+    parent_span: Optional[str] = None
+
+    @classmethod
+    def new(cls, request_id: Any = None) -> "TraceContext":
+        return cls(trace_id=f"req-{request_id}-{next(_ids)}")
+
+    def child(self, span: str) -> "TraceContext":
+        return dataclasses.replace(self, parent_span=span)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "parent_span": self.parent_span}
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "TraceContext":
+        return cls(trace_id=str(d["trace_id"]),
+                   parent_span=d.get("parent_span"))
+
+
+def attribution_fractions(
+    wall_s: float,
+    components: Mapping[str, float],
+    *,
+    residual: str,
+) -> Optional[Dict[str, Any]]:
+    """Decompose ``wall_s`` into named fractions that sum to 1.0.
+
+    Components clip cumulatively to the wall (order matters — list the
+    best-measured first); whatever remains lands in the ``residual``
+    bucket, computed as ``1 - sum(rounded others)`` so the rounded
+    fractions add up exactly (the step-anatomy discipline)."""
+    wall = float(wall_s)
+    if wall <= 0.0:
+        return None
+    out: Dict[str, Any] = {"wall_s": round(wall, 6)}
+    used = 0.0
+    clipped: Dict[str, float] = {}
+    for name, v in components.items():
+        v = min(max(float(v or 0.0), 0.0), wall - used)
+        clipped[name] = v
+        used += v
+    acc = 0.0
+    for name, v in clipped.items():
+        f = round(v / wall, 4)
+        out[f"{name}_frac"] = f
+        acc += f
+    out[f"{residual}_frac"] = round(max(1.0 - acc, 0.0), 4)
+    return out
+
+
+class PhaseHistogram:
+    """Bounded per-phase latency histogram over ``HIST_EDGES_S``.
+
+    Non-sampled requests fold here instead of emitting span trees, so
+    the trace stream stays flat under load: one ``kind="reqhist"``
+    record no matter how many requests retired."""
+
+    __slots__ = ("phases",)
+
+    def __init__(self) -> None:
+        self.phases: Dict[str, Dict[str, Any]] = {}
+
+    def add(self, phase: str, seconds: float) -> None:
+        s = max(float(seconds), 0.0)
+        row = self.phases.get(phase)
+        if row is None:
+            row = {"counts": [0] * (len(HIST_EDGES_S) + 1),
+                   "total_s": 0.0, "n": 0}
+            self.phases[phase] = row
+        row["counts"][bisect.bisect_right(HIST_EDGES_S, s)] += 1
+        row["total_s"] += s
+        row["n"] += 1
+
+    @property
+    def empty(self) -> bool:
+        return not self.phases
+
+    def reset(self) -> None:
+        self.phases = {}
+
+    def record(self) -> Dict[str, Any]:
+        return {
+            "kind": "reqhist",
+            "edges_s": list(HIST_EDGES_S),
+            "phases": {p: {"counts": list(r["counts"]),
+                           "total_s": round(r["total_s"], 6), "n": r["n"]}
+                       for p, r in sorted(self.phases.items())},
+        }
+
+
+__all__ = ["TraceContext", "PhaseHistogram", "attribution_fractions",
+           "HIST_EDGES_S"]

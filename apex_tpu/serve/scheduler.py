@@ -37,6 +37,13 @@ class Request:
     # prompt tokens whose k/v came from the prefix cache (prefill skipped
     # straight past them to the divergence point; 0 = no hit / cache off)
     cached_tokens: int = 0
+    # serializable trace context ({"trace_id", "parent_span"}) — the engine
+    # assigns one at submit when absent; an externally provided context
+    # propagates as-is (the cross-worker handoff seam, ROADMAP item 4)
+    trace: Optional[Dict[str, Any]] = None
+    # per-class latency attribution ({"ttft": {...}, "itl": {...}} fraction
+    # dicts, reqtrace.attribution_fractions shape) — stamped at retire
+    attribution: Optional[Dict[str, Any]] = None
 
     def __post_init__(self):
         if self.request_id is None:

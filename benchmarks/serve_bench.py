@@ -21,6 +21,14 @@ the gated claims are structural):
    prefill engine and a CHUNKED one: the monolithic baseline's stall
    inflates running streams' ITL tail and trips the ``report compare``
    ITL gate, while the chunked engine's self-compare holds.
+4. **request-scoped tracing** (ISSUE 17) — tail sampling retains 100% of
+   SLO violators and exactly 1-in-N compliant requests (rest folded into
+   one bounded reqhist record), attribution fractions sum to 1.0 per
+   request and in the report rollup, the Chrome export carries one lane
+   per sampled request, ``report compare`` flags a queue-inflated
+   candidate, and the monolithic long-prompt stall names itself in the
+   worst decode tick's prefill attribution. Own atomic artifact:
+   ``out/reqtrace_evidence.json``.
 
 Writes ``out/serve_evidence.json`` (one JSON object, ``ok: true`` iff all
 checks hold). Run:
@@ -63,6 +71,9 @@ from apex_tpu.serve import Engine, Request, ServeConfig
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--output", default="out/serve_evidence.json")
+    p.add_argument("--reqtrace-output", default="out/reqtrace_evidence.json",
+                   help="separate artifact for the request-scoped tracing "
+                        "phase (ISSUE 17)")
     p.add_argument("--journal", default="out/serve_bench.jsonl")
     p.add_argument("--requests", type=int, default=12,
                    help="baseline-phase request count (PR 9 load)")
@@ -357,17 +368,232 @@ def phase_long_prompt_itl(args):
     }
 
 
+def phase_reqtrace(args):
+    """Request-scoped tracing evidence (ISSUE 17), all structural:
+
+    - attribution fractions sum to 1.0 per request AND in the report
+      rollup;
+    - tail sampling retains 100% of SLO violators and exactly
+      ``ceil(n/N)`` compliant requests under shared-prefix load, with
+      the rest folded into ONE bounded reqhist record;
+    - the Chrome export carries one named lane per sampled request;
+    - ``report compare`` flags a queue-inflated candidate through the
+      queue-fraction gates and passes self-compare;
+    - the chunked-vs-monolithic long-prompt ITL gap is ATTRIBUTED: the
+      monolithic run's worst decode tick is prefill-dominated in its
+      per-tick span attrs, and the chunked run's MEDIAN prefill-carrying
+      tick does far less serialized prefill work per tick.
+    """
+    from apex_tpu.monitor import tracing
+
+    model, params = build_model(args)
+    rng = np.random.default_rng(args.seed + 3)
+    prefix = list(rng.integers(0, args.vocab, args.shared_prefix_len))
+    n = 10 * args.max_batch
+    prompts = [prefix + list(rng.integers(0, args.vocab,
+                                          int(rng.integers(3, 9))))
+               for _ in range(n)]
+
+    def traced_run(slo_itl_ms, sample_n, tag):
+        eng = Engine(model, params, ServeConfig(
+            max_batch=args.max_batch, max_seq=48, block_size=8,
+            seed=args.seed, prefix_cache=True, prefill_chunk=16,
+            slo_itl_ms=slo_itl_ms, trace_sample_n=sample_n))
+        journal = fresh_journal(
+            args.journal.replace(".jsonl", f"_rt_{tag}.jsonl"))
+        reqs = [Request(prompt=p, max_new_tokens=6, request_id=i)
+                for i, p in enumerate(prompts)]
+        tr = tracing.Tracer(None, keep=True)
+        with tracing.scoped(tr):
+            with MetricsJournal(journal, meta={
+                    "run": f"serve_bench_reqtrace_{tag}"}) as j:
+                eng.run(reqs, journal=j)
+        eng.drop_prefix_cache()
+        assert eng.allocator.used == 0 and eng.batcher.idle
+        return eng, tr, MetricsJournal.read(journal)
+
+    # (a) impossible ITL target: every request violates -> 100% retention
+    eng_v, tr_v, rows_v = traced_run(1e-6, 10 ** 6, "violator")
+    roots_v = [r for r in tr_v.records if r.get("name") == "serve.request"]
+    # (b) no violations: deterministic 1-in-N + one bounded histogram
+    sample_n = 8
+    eng_s, tr_s, rows_s = traced_run(1e9, sample_n, "sampled")
+    roots_s = [r for r in tr_s.records if r.get("name") == "serve.request"]
+    hists = [r for r in tr_s.records if r.get("kind") == "reqhist"]
+    want_sampled = -(-n // sample_n)  # ceil
+    folded = ((hists[0]["phases"].get("ttft") or {}).get("n")
+              if hists else None)
+
+    def frac_sums_ok(rows):
+        oks = []
+        for r in rows:
+            if r.get("kind") != "request":
+                continue
+            for fr in (r.get("attribution") or {}).values():
+                if isinstance(fr, dict):
+                    oks.append(abs(sum(
+                        v for k, v in fr.items()
+                        if k.endswith("_frac")) - 1.0) < 1e-3)
+        return bool(oks) and all(oks)
+
+    sv = report_mod.analyze(rows_v).get("serving") or {}
+    attr = sv.get("attribution") or {}
+    rollup_ok = bool(attr) and all(
+        abs(sum(v for k, v in row.items()
+                if k.endswith("_frac")) - 1.0) < 1e-3
+        for row in attr.values())
+
+    # one Chrome lane per sampled request (thread_name metadata rows)
+    chrome = tracing.chrome_trace(tr_s.records)
+    lanes = [e for e in chrome["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"
+             and str((e.get("args") or {}).get("name", "")
+                     ).startswith("request ")]
+
+    # queue-inflated candidate: shift 0.4 of every request's attribution
+    # mass into the queue bucket (renormalizing the rest so each class
+    # still sums to 1.0) — ONLY the queue-fraction gates may trip
+    inflated = []
+    for r in rows_v:
+        r2 = dict(r)
+        if r2.get("kind") == "request" and isinstance(
+                r2.get("attribution"), dict):
+            at2 = {}
+            for cls, fr in r2["attribution"].items():
+                if not isinstance(fr, dict):
+                    continue
+                fr2 = dict(fr)
+                fr2["queue_frac"] = min(
+                    (fr.get("queue_frac") or 0.0) + 0.4, 1.0)
+                others = [k for k in fr2
+                          if k.endswith("_frac") and k != "queue_frac"]
+                rest = 1.0 - fr2["queue_frac"]
+                tot = sum(fr.get(k) or 0.0 for k in others) or 1.0
+                for k in others:
+                    fr2[k] = round((fr.get(k) or 0.0) * rest / tot, 4)
+                at2[cls] = fr2
+            r2["attribution"] = at2
+        inflated.append(r2)
+    gate = report_mod.compare(rows_v, inflated, threshold=0.10)
+    gate_trips = (not gate["ok"] and gate["regressed"]
+                  and set(gate["regressed"]) <= {"ttft_queue_frac",
+                                                 "itl_queue_frac"})
+    self_gate = report_mod.compare(rows_v, rows_v, threshold=0.10)
+
+    # (c) chunked-vs-monolithic ITL gap, ATTRIBUTED per tick: the span
+    # trees' req.decode_tick attrs carry each tick's prefill/compute/
+    # barrier seconds, so the monolithic stall names itself
+    long_len = 192
+    max_seq = long_len + args.max_new_tokens + 64
+    model2, params2 = build_model(args, max_seq_len=max_seq)
+    rng2 = np.random.default_rng(args.seed + 4)
+    short_prompts = [list(rng2.integers(0, args.vocab, 6))
+                     for _ in range(args.max_batch - 1)]
+    long_prompt = list(rng2.integers(0, args.vocab, long_len))
+
+    def tick_spans(chunk):
+        eng = Engine(model2, params2, ServeConfig(
+            max_batch=args.max_batch, max_seq=max_seq, block_size=8,
+            seed=args.seed, prefill_chunk=chunk, slo_itl_ms=1e-6,
+            trace_sample_n=10 ** 6))
+        eng.run([Request(prompt=long_prompt[:(chunk or 0) + 8],
+                         max_new_tokens=2, request_id="warm")])
+        t0 = eng.ticks
+        shorts = [Request(prompt=p, max_new_tokens=30, request_id=i)
+                  for i, p in enumerate(short_prompts)]
+        long_req = Request(prompt=long_prompt, max_new_tokens=4,
+                           request_id="long")
+
+        def inject(engine):
+            if engine.ticks == t0 + 4:
+                engine.submit(long_req)
+
+        tr = tracing.Tracer(None, keep=True)
+        with tracing.scoped(tr):
+            eng.run(shorts, on_tick=inject)
+        return [r for r in tr.records
+                if r.get("name") == "req.decode_tick"]
+
+    def prefill_per_tick(spans):
+        """Seconds of prefill work per UNIQUE tick that carried any
+        (the spans repeat per running stream)."""
+        by_tick = {}
+        for r in spans:
+            pf = r.get("prefill_s") or 0.0
+            if pf > 0:
+                by_tick[r.get("tick")] = pf
+        return sorted(by_tick.values())
+
+    mono_spans = tick_spans(None)
+    chunk_spans = tick_spans(32)
+    mono = max(mono_spans, key=lambda r: r.get("dur_s") or 0.0)
+    mono_prefill_share = ((mono.get("prefill_s") or 0.0)
+                          / max(mono["dur_s"], 1e-12))
+    # chunking bounds the TYPICAL per-tick prefill serialization (the
+    # median over prefill-carrying ticks) even though the long request's
+    # admission tick itself can spike — worst-vs-worst would compare two
+    # one-off spikes, the median is the structural claim
+    mono_pf = prefill_per_tick(mono_spans)
+    chunk_pf = prefill_per_tick(chunk_spans)
+    mono_med = mono_pf[len(mono_pf) // 2] if mono_pf else 0.0
+    chunk_med = chunk_pf[len(chunk_pf) // 2] if chunk_pf else 1e9
+
+    checks = {
+        "violators_fully_retained": (
+            len(roots_v) == n and eng_v.trace_violators == n),
+        "compliant_sampled_1_in_n": (
+            len(roots_s) == want_sampled
+            and eng_s.trace_sampled == want_sampled),
+        "one_bounded_histogram": (
+            len(hists) == 1 and folded == n - want_sampled),
+        "request_fractions_sum_to_1": (
+            frac_sums_ok(rows_v) and frac_sums_ok(rows_s)),
+        "report_attribution_sums_to_1": rollup_ok,
+        "chrome_one_lane_per_sampled_request": (
+            len(lanes) == want_sampled),
+        "compare_flags_queue_inflation": bool(gate_trips),
+        "compare_passes_self": bool(self_gate["ok"]),
+        "monolithic_stall_attributed_to_prefill": mono_prefill_share > 0.5,
+        "chunked_median_prefill_tick_below_monolithic": (
+            chunk_med < mono_med),
+    }
+    return checks, {
+        "requests": n,
+        "trace_sample_n": sample_n,
+        "violator_roots": len(roots_v),
+        "sampled_roots": len(roots_s),
+        "histogram_folded_ttft_n": folded,
+        "report_attribution": attr,
+        "chrome_request_lanes": len(lanes),
+        "compare_regressed": gate["regressed"],
+        "worst_tick_monolithic": {
+            "dur_s": mono["dur_s"], "prefill_s": mono.get("prefill_s"),
+            "prefill_share": round(min(mono_prefill_share, 1.0), 4)},
+        "prefill_s_per_tick_median": {
+            "monolithic": round(mono_med, 6), "chunked": round(chunk_med, 6),
+            "monolithic_ticks": len(mono_pf), "chunked_ticks": len(chunk_pf)},
+    }
+
+
 def main() -> int:
     args = parse_args()
     phases = {}
     checks = {}
     for name, fn in (("baseline", phase_baseline),
                      ("shared_prefix", phase_shared_prefix),
-                     ("long_prompt", phase_long_prompt_itl)):
+                     ("long_prompt", phase_long_prompt_itl),
+                     ("reqtrace", phase_reqtrace)):
         ph_checks, detail = fn(args)
         phases[name] = {"checks": ph_checks, **detail}
         for k, v in ph_checks.items():
             checks[f"{name}.{k}"] = v
+
+    # the request-tracing phase ships its own atomic artifact (ISSUE 17
+    # acceptance surface) in addition to riding the main record
+    rt = phases["reqtrace"]
+    atomic_write_json(args.reqtrace_output, {
+        "bench": "serve_bench.reqtrace",
+        "ok": all(rt["checks"].values()), **rt})
 
     record = {
         "bench": "serve_bench",

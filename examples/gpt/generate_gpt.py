@@ -118,6 +118,11 @@ def parse_args():
                         "slo-burn health rule gates attainment)")
     p.add_argument("--slo-itl-ms", type=float, default=None,
                    help="ITL target in ms (see --slo-ttft-ms)")
+    p.add_argument("--trace-sample-n", type=int, default=16, metavar="N",
+                   help="tail-based sampling rate for request span trees "
+                        "under --trace: every SLO violator keeps its full "
+                        "tree, plus 1-in-N compliant requests; the rest "
+                        "fold into one bounded kind=\"reqhist\" record")
     p.add_argument("--ledger", nargs="?", const="out/ledger.jsonl",
                    default=None, metavar="PATH",
                    help="append one fingerprinted run record (serve "
@@ -220,7 +225,8 @@ def main():
         top_k=args.top_k, seed=args.seed,
         prefix_cache=args.prefix_cache, prefill_chunk=args.prefill_chunk,
         spec_k=args.spec_k,
-        slo_ttft_ms=args.slo_ttft_ms, slo_itl_ms=args.slo_itl_ms),
+        slo_ttft_ms=args.slo_ttft_ms, slo_itl_ms=args.slo_itl_ms,
+        trace_sample_n=args.trace_sample_n),
         mesh=mesh,
         draft_model=draft_model, draft_params=draft_params)
     prompts = load_prompts(args)
@@ -266,6 +272,15 @@ def main():
                     serving["ttft_ms"] = {"p50": round(mid(ttfts), 3)}
                 if itls:
                     serving["itl_ms"] = {"p50": round(mid(itls), 3)}
+                # attribution rides the ledger even journal-less, so
+                # `ledger regress` can gate TTFT-attribution drift
+                from apex_tpu.monitor.report import attribution_rollup
+
+                attr = attribution_rollup(
+                    [r.attribution for r in results.values()
+                     if isinstance(r.attribution, dict)])
+                if attr:
+                    serving["attribution"] = attr
                 measured = {"step_records": engine.ticks,
                             "serving": serving}
             rec = ledger_mod.append_run(
