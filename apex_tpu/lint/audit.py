@@ -22,7 +22,13 @@ CPU mesh:
                        with the ``moe-dispatch`` tripwire armed
                        (ISSUE 15);
 - ``serve_prefill``/``serve_decode`` — the serving engine's two
-                       shape-stable jitted programs over the paged cache.
+                       shape-stable jitted programs over the paged cache;
+- ``plan``           — the auto-parallelism planner's loop closed: a
+                       ZeRO-3-constrained ``apex_tpu.plan.search`` winner
+                       traced via its ``feasibility_step`` and audited by
+                       the ``plan-feasibility`` pass — the trace must
+                       match the prediction class the planner priced
+                       (ISSUE 18).
 
 Emits ONE JSON line (``{"audit": {..., "all_ok": bool}}``) and exits 0
 iff every program audits clean: no unsuppressed pass findings, no
@@ -313,6 +319,28 @@ def _build_moe():
     return jax.value_and_grad(loss_fn), (local,)
 
 
+def _build_plan():
+    """The planner's loop closed: search the tiny spec under a ZeRO-3
+    constraint (every other knob free), then build the winner's claimed
+    grads program (``plan.feasibility_step``) so the ``plan-feasibility``
+    pass can audit the trace against the plan's prediction class."""
+    from apex_tpu import plan as plan_mod
+
+    spec = plan_mod.ModelSpec("plan-tiny", 128, 64, 4, 4, 32)
+    result = plan_mod.search(spec, mesh=8, hbm_gb=16.0, platform="cpu",
+                             constraints={"zero_level": 3, "pp": 1})
+    winner = result["winner"]
+    if winner is None:  # 16 GiB fits the tiny spec by construction
+        raise RuntimeError("plan audit program: no feasible ZeRO-3 "
+                           "candidate for the tiny spec")
+    cand = plan_mod.Candidate(**winner["candidate"])
+    step = plan_mod.feasibility_step(spec, cand)
+    if step is None:
+        raise RuntimeError(f"plan audit program: winner {cand} has no "
+                           "feasibility trace")
+    return step
+
+
 def _build_serve():
     """The serving engine's two shape-stable jitted programs (prefill,
     decode) on a serial tiny build — the argument streams come from the
@@ -345,7 +373,7 @@ def run_audit(programs: Optional[Iterable[str]] = None,
 
     ensure_jax_compat()  # jax<0.5: the builders use jax.shard_map
     known = {"dense", "zero", "zero3_prefetch", "zerobubble", "moe",
-             "serve_prefill", "serve_decode"}
+             "serve_prefill", "serve_decode", "plan"}
     wanted = set(programs) if programs else None
     if wanted is not None and wanted - known:
         # a typo'd CI subset must never audit 0 programs and exit green
@@ -405,6 +433,13 @@ def run_audit(programs: Optional[Iterable[str]] = None,
                 ("moe-dispatch", lambda ir: lint_trace.moe_dispatch_hazards(
                     ir, expert_axis="data", wire_dtype="int8")),
             ]))
+    if want("plan"):
+        step = _build_plan()
+        record("plan", audit_step_program(
+            step["fn"], *step["args"], label="plan", axes=step["axes"],
+            options={**opts, "plan-feasibility": {
+                "plan": step["plan"],
+                "model_elems": step["model_elems"]}}))
     if want("serve_prefill") or want("serve_decode"):
         eng = _build_serve()
         if want("serve_prefill"):
@@ -511,7 +546,7 @@ def main(argv=None) -> int:
     p.add_argument("--programs", type=str, default=None,
                    help="comma-separated subset (dense,zero,"
                         "zero3_prefetch,zerobubble,moe,serve_prefill,"
-                        "serve_decode)")
+                        "serve_decode,plan)")
     p.add_argument("--hbm-check", action="store_true",
                    help="add the 110M-class static-vs-monitor.hbm "
                         "peak-bytes cross-check")

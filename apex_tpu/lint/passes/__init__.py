@@ -21,6 +21,10 @@ programs. Pass-author guide: ``apex_tpu/lint/passes/README.md``.
   from collective equations, reconciled against the same trace's
   ``CommAccount.by_verb_dtype`` books (unbooked traffic = a verb missing
   its ``comm:`` scope).
+- ``plan-feasibility`` — a planner-emitted config's traced step must
+  match its prediction class (ZeRO-3 per-layer gathers, scattered
+  ZeRO-1/2 reduce, quantized wire, expert-parallel dispatch); inert
+  without a ``plan`` option.
 
 No reference analog: the reference ships no static analysis
 (apex_tpu/lint/__init__.py).
@@ -29,6 +33,7 @@ No reference analog: the reference ships no static analysis
 from apex_tpu.lint.passes import collective_consistency  # noqa: F401
 from apex_tpu.lint.passes import comm_bytes  # noqa: F401
 from apex_tpu.lint.passes import dtype_drift  # noqa: F401
+from apex_tpu.lint.passes import plan_feasibility  # noqa: F401
 from apex_tpu.lint.passes import static_hbm  # noqa: F401
 
 from apex_tpu.lint.passes.collective_consistency import (  # noqa: F401
@@ -36,4 +41,7 @@ from apex_tpu.lint.passes.collective_consistency import (  # noqa: F401
 )
 from apex_tpu.lint.passes.comm_bytes import comm_bytes_pass  # noqa: F401
 from apex_tpu.lint.passes.dtype_drift import dtype_drift_pass  # noqa: F401
+from apex_tpu.lint.passes.plan_feasibility import (  # noqa: F401
+    plan_feasibility_pass,
+)
 from apex_tpu.lint.passes.static_hbm import static_hbm_pass  # noqa: F401

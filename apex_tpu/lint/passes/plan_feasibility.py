@@ -1,0 +1,123 @@
+"""plan-feasibility pass: a traced step must match its plan's claims.
+
+The planner (``apex_tpu.plan``) prices candidates analytically; this
+pass is the static self-consistency check that makes those prices
+trustworthy: given the prediction-class summary of a planner-emitted
+config (``plan_summary``: zero level, expert axis, wire dtypes), audit
+the TRACED step for the collective shapes the prediction assumed.
+Contradictions — each a class whose cost model would be silently wrong:
+
+- plan scored as **ZeRO-3** but the trace gathers model-sized params in
+  bulk (the O(model) rematerialization ``zero3_gather_hazards`` hunts):
+  the priced 1/dp residency does not exist;
+- plan scored as **ZeRO-1/2** but a bulk data-axis grad psum remains on
+  top of the scatter (``zero_redundancy_hazards``): the wire bytes are
+  double the priced scatter;
+- plan scored with a **quantized grad wire** but the bulk reduce moves
+  at >= 2 B/elem or the error-feedback residual is missing
+  (``quantized_comm_hazards``): the priced 1 B/elem wire is fiction;
+- plan scored as **expert-parallel** but the trace has no dispatch
+  all_to_all over the expert axis (replicated experts), or dispatches
+  fat under a quantized-wire request (``moe_dispatch_hazards``).
+
+Without a ``plan`` option the pass reports ``audited: False`` and no
+findings — it only fires on programs that CLAIM a plan (the ``plan``
+audit program, planner tests), so unrelated audit programs are
+untouched. The delegated analyzers run on the SHARED single-trace
+walker (``fn`` here is already a StepIR — no re-trace).
+
+No reference analog: the reference ships no static analysis
+(apex_tpu/lint/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.lint import ir as ir_mod
+
+RULE = "plan-feasibility"
+
+
+def _adopt(findings: List[Dict[str, Any]], claim: str,
+           out: List[Dict[str, Any]]) -> None:
+    for f in findings:
+        g = dict(f)
+        g["rule"] = RULE
+        g["plan_claim"] = claim
+        g["message"] = (f"plan scored as {claim} but the traced step "
+                        f"contradicts it: {f.get('message', f.get('rule'))}")
+        out.append(g)
+
+
+def plan_feasibility_pass(
+    ir,
+    *,
+    plan: Optional[Dict[str, Any]] = None,
+    model_elems: Optional[int] = None,
+    min_model_elems: Optional[int] = None,
+    min_bulk_elems: int = 1 << 12,
+) -> Dict[str, Any]:
+    """Audit one traced step against its plan's prediction classes.
+
+    ``plan`` is ``apex_tpu.plan.plan_summary(candidate)`` (or any dict
+    with the same keys); ``model_elems``/``min_model_elems`` feed the
+    bulk-gather threshold exactly as ``zero3_gather_hazards`` takes
+    them. Returns ``{findings, audited, census}`` — ``census`` carries
+    each delegated analyzer's verdict for provenance."""
+    from apex_tpu.lint import trace as lint_trace
+
+    if not plan:
+        return {"findings": [], "audited": False, "census": {}}
+    ir = ir_mod.ensure_ir(ir)
+    findings: List[Dict[str, Any]] = []
+    census: Dict[str, Any] = {}
+    zero_level = int(plan.get("zero_level") or 0)
+    zero_axis = plan.get("zero_axis") or "data"
+
+    if zero_level >= 3:
+        hz = lint_trace.zero3_gather_hazards(
+            ir, zero_axis=zero_axis, model_elems=model_elems,
+            min_model_elems=min_model_elems)
+        census["zero3_gather"] = {
+            "hazard": hz["hazard"], "layer_gathers": hz["layer_gathers"],
+            "bulk_gathers": hz["bulk_gathers"]}
+        if hz["hazard"]:
+            _adopt(hz["findings"], "ZeRO-3 (per-layer gathers)", findings)
+    elif zero_level in (1, 2):
+        hz = lint_trace.zero_redundancy_hazards(
+            ir, zero_axis=zero_axis, min_bulk_elems=min_bulk_elems)
+        census["zero_redundancy"] = {"hazard": hz["hazard"]}
+        if hz["hazard"]:
+            _adopt(hz["findings"],
+                   f"ZeRO-{zero_level} (scattered grad reduce)", findings)
+        if plan.get("reduce_dtype"):
+            hq = lint_trace.quantized_comm_hazards(
+                ir, zero_axis=zero_axis, min_bulk_elems=min_bulk_elems)
+            census["quantized_comm"] = {"hazard": hq["hazard"]}
+            if hq["hazard"]:
+                _adopt(hq["findings"],
+                       f"quantized ({plan['reduce_dtype']}) grad wire",
+                       findings)
+
+    if plan.get("moe_expert_axis"):
+        hm = lint_trace.moe_dispatch_hazards(
+            ir, expert_axis=plan["moe_expert_axis"],
+            wire_dtype=plan.get("moe_dispatch_dtype"),
+            min_bulk_elems=min_bulk_elems)
+        census["moe_dispatch"] = {"hazard": hm["hazard"]}
+        if hm["hazard"]:
+            _adopt(hm["findings"], "expert-parallel MoE dispatch",
+                   findings)
+
+    return {"findings": findings, "audited": True, "census": census,
+            "plan": {k: plan.get(k) for k in (
+                "zero_level", "zero_axis", "zero3_prefetch",
+                "reduce_dtype", "moe_expert_axis", "moe_dispatch_dtype")}}
+
+
+ir_mod.register_pass(
+    RULE,
+    "a planner-emitted config's traced step must match its prediction "
+    "class (ZeRO-3 per-layer gathers, scattered ZeRO-1/2 reduce, "
+    "quantized wire, expert-parallel dispatch)")(plan_feasibility_pass)

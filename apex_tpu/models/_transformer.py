@@ -26,6 +26,15 @@ from apex_tpu.ops.layer_norm import layer_norm as fused_layer_norm_op
 from apex_tpu.transformer import tensor_parallel as tp
 from apex_tpu.utils.nn import inverted_dropout
 
+#: the ONE rejection text for ``zero3_prefetch`` without unrolled layers —
+#: shared by the trace-time check here (run_layers) and the build-time
+#: check in ``transformer.amp.build_zero_train_step`` so harness and audit
+#: reject with identical words (tests pin the equality)
+ZERO3_PREFETCH_NEEDS_UNROLL = (
+    "zero3_prefetch needs unroll_layers=True: the double-buffered gather "
+    "schedule is a static unrolled structure (a lax.scan has one gather "
+    "call site to prefetch around)")
+
 Params = Dict[str, Any]
 
 
@@ -591,11 +600,7 @@ class TransformerBase:
             prefetch = int(getattr(self.cfg, "zero3_prefetch", 0) or 0)
             if prefetch > 0:
                 if not getattr(self.cfg, "unroll_layers", False):
-                    raise ValueError(
-                        "zero3_prefetch needs unroll_layers=True: the "
-                        "double-buffered gather schedule is a static "
-                        "unrolled structure (a lax.scan has one gather "
-                        "call site to prefetch around)")
+                    raise ValueError(ZERO3_PREFETCH_NEEDS_UNROLL)
                 if aux0 is not None:
                     raise ValueError(
                         "zero3_prefetch does not support aux-emitting "

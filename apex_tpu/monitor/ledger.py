@@ -193,6 +193,11 @@ def _finish_predicted(pred: Dict[str, Any]) -> Dict[str, Any]:
     if not (isinstance(flops, (int, float)) and flops > 0) and not (
             isinstance(comm, (int, float)) and comm > 0):
         return pred
+    if isinstance(pred.get("modeled_step_s"), (int, float)):
+        # a caller-provided model (the planner's bubble/overlap-aware
+        # step seconds, pretrain_gpt --plan auto) outranks the simple
+        # no-overlap sum here — don't overwrite it
+        return pred
     try:
         from apex_tpu.monitor import mfu as _mfu
         from apex_tpu.monitor import tracing as _tracing

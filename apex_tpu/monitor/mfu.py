@@ -129,6 +129,26 @@ def peak_spec(platform: Optional[str] = None) -> Dict[str, Any]:
             "peak_hbm_bytes_per_sec": bw, "source": source}
 
 
+def modeled_compute_seconds(
+    flops: float,
+    *,
+    spec: Optional[Dict[str, Any]] = None,
+    platform: Optional[str] = None,
+) -> float:
+    """Compute-time floor of ``flops`` against the resolved peak spec.
+
+    The planner's (``apex_tpu.plan``) compute leg: honors the same
+    calibrated > env > table > fallback precedence as :func:`peak_spec`,
+    so an armed ``APEX_TPU_CALIBRATION`` file closes the
+    predicted-vs-measured loop with no planner-side knobs. Returns
+    ``inf`` when the spec resolves no FLOP ceiling (nothing to divide
+    by — an infeasible time floor, never a silent 0).
+    """
+    spec = spec or peak_spec(platform)
+    pf = spec.get("peak_flops") or 0.0
+    return float(flops) / pf if pf > 0 else float("inf")
+
+
 def mfu_metrics(
     *,
     flops: float,

@@ -1029,6 +1029,41 @@ def _check_ledger() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _check_plan() -> dict:
+    """The auto-parallelism planner (ISSUE 18): a tiny search ranks
+    candidates off-TPU with the full predicted anatomy on every record,
+    an impossible budget rejects EVERYTHING with static-hbm provenance
+    (no silent empty tables), and the ``plan`` audit program — the
+    winner's claimed step traced and checked by the ``plan-feasibility``
+    IR pass — audits clean end to end."""
+    from apex_tpu import plan as plan_mod
+    from apex_tpu.lint import audit as lint_audit
+
+    spec = plan_mod.ModelSpec("selftest-tiny", 128, 64, 4, 4, 32)
+    result = plan_mod.search(spec, mesh=8, hbm_gb=16.0, platform="cpu")
+    assert result["winner"], result["rejected"][:3]
+    for rec in result["ranked"]:
+        pred = rec["predicted"]
+        assert pred["hbm_bytes"] > 0 and pred["step_seconds"] > 0, rec
+        assert "ici" in pred["comm_bytes_by_tier"], rec
+        assert 0.0 <= pred["bubble_floor"] < 1.0, rec
+
+    # a budget nothing fits must reject every candidate WITH provenance
+    broke = plan_mod.search(spec, mesh=8, hbm_bytes=1 << 10,
+                            platform="cpu")
+    assert broke["winner"] is None, broke["winner"]
+    assert broke["rejected"], "empty rejection table"
+    assert all(r["rejected_by"] for r in broke["rejected"]), broke
+
+    verdict = lint_audit.run_audit(programs=("plan",))
+    assert verdict["all_ok"], verdict
+    feas = verdict["programs"]["plan"]["passes"]["plan-feasibility"]
+    assert feas["audited"] and not feas["findings"], feas
+    return {"ok": True, "ranked": len(result["ranked"]),
+            "rejected": len(broke["rejected"]),
+            "winner_zero": result["winner"]["candidate"]["zero_level"]}
+
+
 def run() -> dict:
     """In-process smoke (no platform mutation — safe under any backend)."""
     results = {}
@@ -1044,6 +1079,7 @@ def run() -> dict:
                      ("ledger", _check_ledger),
                      ("lint", _check_lint),
                      ("audit", _check_audit),
+                     ("plan", _check_plan),
                      ("tracing", _check_tracing),
                      ("serve", _check_serve),
                      ("reqtrace", _check_reqtrace)):

@@ -481,6 +481,56 @@ def ici_spec(platform: Optional[str] = None) -> Dict[str, Any]:
     return {"platform": plat, "ici_bytes_per_sec": bw, "source": source}
 
 
+def modeled_step_seconds(
+    *,
+    flops: float,
+    comm_bytes: float,
+    bubble_fraction: float = 0.0,
+    hidden_comm_bytes: float = 0.0,
+    overhead_s: float = 0.0,
+    spec: Optional[Dict[str, Any]] = None,
+    ici: Optional[Dict[str, Any]] = None,
+    platform: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Compose one modeled step time from the analytic legs — the
+    planner's (``apex_tpu.plan``) scoring closure.
+
+    ``flops / peak_flops`` (``mfu.modeled_compute_seconds``) inflated by
+    the schedule's bubble floor, plus the exposed wire time:
+    ``comm_bytes / ici_bytes_per_sec`` minus whatever
+    ``hidden_comm_bytes`` overlap (e.g. the ZeRO-3 prefetched gathers)
+    can hide under compute — capped at the compute time itself, the same
+    cap :func:`step_anatomy` applies to measured overlap. Both
+    denominators resolve through :func:`mfu.peak_spec` /
+    :func:`ici_spec`, so an armed ``APEX_TPU_CALIBRATION`` file (ISSUE
+    16) calibrates every planner prediction with no extra wiring.
+    Returns the decomposition, never just the total, so consumers can
+    stamp ``compute_s``/``exposed_comm_s`` provenance.
+    """
+    from apex_tpu.monitor import mfu as _mfu
+
+    spec = spec or _mfu.peak_spec(platform)
+    ici = ici or ici_spec(platform)
+    compute_s = _mfu.modeled_compute_seconds(flops, spec=spec)
+    bw = ici.get("ici_bytes_per_sec") or 0.0
+    comm_s = float(comm_bytes) / bw if bw > 0 else 0.0
+    hidden_s = min(float(hidden_comm_bytes) / bw, compute_s) if bw > 0 else 0.0
+    exposed_s = max(comm_s - hidden_s, 0.0)
+    bub = min(max(float(bubble_fraction), 0.0), 0.99)
+    step_s = compute_s / (1.0 - bub) + exposed_s + float(overhead_s)
+    return {
+        "step_seconds": step_s,
+        "compute_s": compute_s,
+        "comm_s": comm_s,
+        "exposed_comm_s": exposed_s,
+        "hidden_comm_s": hidden_s,
+        "bubble_fraction": bub,
+        "overhead_s": float(overhead_s),
+        "peak_source": spec.get("source"),
+        "ici_source": ici.get("source"),
+    }
+
+
 def overlap_fraction(wall_s: float, compute_s: float,
                      comm_s: float) -> Optional[float]:
     """Measured comm/compute overlap: of the cheaper resource's seconds,

@@ -1,0 +1,41 @@
+"""Auto-parallelism planner: static placement search over the lint/IR
+engine (ISSUE 18).
+
+``search(spec, mesh=8, hbm_gb=16)`` enumerates every (dp, tp, pp, vpp,
+schedule, sp, zero, prefetch, wire-dtype, moe, unroll) placement the
+mesh admits, prices each one analytically (sharded residency + wire
+bytes + bubble floor + modeled step seconds through the calibrated peak
+specs) and ranks the feasible ones — off-TPU, in seconds, with named
+rejection provenance. ``feasibility_step`` builds the traced program a
+winner claims so the ``plan-feasibility`` IR pass can audit prediction
+against trace. CLI: ``python -m apex_tpu.plan --model gpt-345m --mesh 8
+--hbm-gb 16 [--format json]``; harness: ``pretrain_gpt --plan auto``.
+
+No reference analog: the reference trains at one hand-chosen placement
+per script (reference examples/*); nothing searches.
+"""
+
+from apex_tpu.plan.feasible import feasibility_step, plan_summary
+from apex_tpu.plan.search import (
+    MODEL_PRESETS,
+    Candidate,
+    ModelSpec,
+    abstract_params,
+    enumerate_candidates,
+    param_census,
+    score_candidate,
+    search,
+)
+
+__all__ = [
+    "MODEL_PRESETS",
+    "Candidate",
+    "ModelSpec",
+    "abstract_params",
+    "enumerate_candidates",
+    "feasibility_step",
+    "param_census",
+    "plan_summary",
+    "score_candidate",
+    "search",
+]

@@ -1,0 +1,155 @@
+"""Feasibility-trace builders: turn a scored candidate into a StepIR.
+
+The planner's scores are analytic; this module makes them auditable by
+building the ACTUAL grads program a candidate's prediction class claims
+(``ir.trace_ir``-ready: abstract args, axis env, no mesh, no device
+execution) so the ``plan-feasibility`` IR pass can check the trace
+against the plan — a bulk model-sized gather in a step scored as ZeRO-3,
+or a missing dispatch all_to_all in a step scored as expert-parallel,
+means the planner's cost model priced a program that does not exist.
+
+Two traceable classes (the ones with load-bearing collective shapes):
+
+- ZeRO-3 (``zero_level=3``, pp=1): the fully-sharded chunk drive under
+  ``value_and_grad`` — the ``gpt_scaling.placement_rung`` idiom
+  (``zero3_meta``/``zero3_shard``/``gather_chunked_tree`` with
+  ``layer_chunk_meta``), honoring the candidate's unroll/prefetch knobs;
+- expert-parallel MoE: ``value_and_grad`` of the EP loss on the
+  per-shard param view (one ``E/dp`` expert slice per rank — the
+  ``lint.audit._build_moe`` idiom), with the candidate's dispatch wire.
+
+Other candidates return None: their prediction classes (dense
+allreduce, ZeRO-1/2 scatter) need a live mesh to build and are covered
+by the existing ``zero``/``dense`` audit programs.
+
+No reference analog: the reference ships no static analysis
+(apex_tpu/lint/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from apex_tpu.plan.search import (
+    Candidate,
+    ModelSpec,
+    abstract_params,
+    model_config_kwargs,
+    param_census,
+)
+
+
+def plan_summary(cand: Candidate) -> Dict[str, Any]:
+    """The prediction-class summary the ``plan-feasibility`` pass audits
+    a trace against (see ``lint/passes/plan_feasibility.py``)."""
+    return {
+        "zero_level": cand.zero_level,
+        "zero_axis": "data" if cand.zero_level else None,
+        "zero3_prefetch": cand.zero3_prefetch,
+        "reduce_dtype": cand.reduce_dtype,
+        "moe_expert_axis": cand.moe_expert_axis,
+        "moe_dispatch_dtype": cand.moe_dispatch_dtype,
+    }
+
+
+def _zero3_step(spec: ModelSpec, cand: Candidate,
+                micro_batch: int) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.distributed import gather_chunked_tree
+
+    kw = model_config_kwargs(spec)
+    if cand.unroll:
+        kw.update(unroll_layers=True, zero3_prefetch=cand.zero3_prefetch)
+    else:
+        kw.update(remat=True)
+    if cand.attention_window:
+        kw.update(attention_window=cand.attention_window)
+    model = GPTModel(GPTConfig(**kw))
+    policy = amp.get_policy("O2")
+    abstract = abstract_params(spec)
+    mp3 = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-4), policy, zero_axis="data", zero_level=3,
+        gather_dtype=cand.gather_dtype or "bf16")
+    meta = mp3.zero3_meta(abstract)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jax.ShapeDtypeStruct((micro_batch, spec.seq), jnp.int32)
+
+    def zero3_loss(p, toks, tgts):
+        chunks = mp3.zero3_shard(p)
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        return model.loss(dict(rest, layers=chunks["layers"]), toks, tgts,
+                          layer_chunk_meta=layer_meta)
+
+    return {
+        "fn": jax.value_and_grad(zero3_loss),
+        "args": (abstract, toks, toks),
+        "axes": {"data": cand.dp},
+        "plan": plan_summary(cand),
+        "model_elems": param_census(spec)["total"],
+        "class": "zero3",
+    }
+
+
+def _moe_step(spec: ModelSpec, cand: Candidate,
+              micro_batch: int) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    kw = model_config_kwargs(spec)
+    kw.update(remat=True, moe_expert_axis=cand.moe_expert_axis,
+              moe_dispatch_dtype=cand.moe_dispatch_dtype)
+    model = GPTModel(GPTConfig(**kw))
+    full = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    local_e = spec.moe_experts // cand.dp
+
+    def shard_expert(leaf):
+        # stacked moe leaves carry the expert dim at axis 1
+        shape = tuple(leaf.shape)
+        return jax.ShapeDtypeStruct((shape[0], local_e) + shape[2:],
+                                    leaf.dtype)
+
+    layers = dict(full["layers"])
+    layers["moe"] = {
+        "router": layers["moe"]["router"],
+        "fc1": jax.tree.map(shard_expert, layers["moe"]["fc1"]),
+        "fc2": jax.tree.map(shard_expert, layers["moe"]["fc2"]),
+    }
+    local = dict(full, layers=layers)
+    toks = jax.ShapeDtypeStruct((micro_batch, spec.seq), jnp.int32)
+
+    def loss_fn(p, toks, tgts):
+        return model.loss(p, toks, tgts)
+
+    return {
+        "fn": jax.value_and_grad(loss_fn),
+        "args": (local, toks, toks),
+        "axes": {"data": cand.dp},
+        "plan": plan_summary(cand),
+        "model_elems": param_census(spec)["total"],
+        "class": "moe",
+    }
+
+
+def feasibility_step(spec: ModelSpec, cand: Candidate, *,
+                     micro_batch: int = 1) -> Optional[Dict[str, Any]]:
+    """Build the traceable grads program for a candidate, or None when
+    its prediction class has no mesh-free trace (see module docstring).
+    Returns ``{fn, args, axes, plan, model_elems, class}`` — feed
+    ``fn(*args)`` to ``ir.trace_ir(..., axes=axes)`` and hand ``plan`` /
+    ``model_elems`` to the ``plan-feasibility`` pass options."""
+    if cand.moe_expert_axis and spec.moe_experts:
+        if spec.moe_experts % cand.dp:
+            return None
+        return _moe_step(spec, cand, micro_batch)
+    if cand.zero_level >= 3 and cand.pp == 1 and cand.dp > 1:
+        return _zero3_step(spec, cand, micro_batch)
+    return None

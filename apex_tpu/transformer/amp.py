@@ -177,6 +177,16 @@ def build_zero_train_step(
                 "zero_level=3 needs zero3=(mp_opt.zero3_init(...)), model= "
                 "and num_microbatches= — the builder rebuilds the pipelined "
                 "loss around the per-layer JIT weight gather")
+        # reject at BUILD time with the same words run_layers uses at
+        # trace time — the harness/audit asymmetry was a prefetch config
+        # that built fine and only died deep inside the first trace
+        if (int(getattr(model.cfg, "zero3_prefetch", 0) or 0) > 0
+                and not getattr(model.cfg, "unroll_layers", False)):
+            from apex_tpu.models._transformer import (
+                ZERO3_PREFETCH_NEEDS_UNROLL,
+            )
+
+            raise ValueError(ZERO3_PREFETCH_NEEDS_UNROLL)
         from apex_tpu.optimizers.distributed import gather_chunked_tree
         from apex_tpu.transformer.pipeline_parallel import pipelined_loss_fn
 

@@ -389,53 +389,55 @@ def placement_rung(*, hidden=2560, layers=34, heads=32, vocab=50304,
 
     This container cannot *execute* a step at this shape (a 2-core CPU
     would take ~10 min/step), and placement is a bytes argument anyway —
-    so the rung prices persistent per-rank residency analytically
-    (``monitor.hbm.param_state_report``: working params + fp32 master +
-    moments, per ZeRO stage) against ``hbm_bytes``, and TRACES the
-    fully-sharded step at the full shape (``jax.make_jaxpr`` on abstract
-    ``ShapeDtypeStruct`` args: no allocation, no compile) to prove the
-    program gathers per layer with no model-sized bulk gather
-    (``lint.trace.zero3_gather_hazards`` census — the same tripwire the
-    selftest runs). Activations/grads ride on top of the priced floor;
-    replicated already fails on the floor alone.
+    so the rung prices per ZeRO stage through the PLANNER's scorer
+    (``apex_tpu.plan.score_candidate``: the sharded-residency model
+    pinned against ``monitor.hbm.param_state_report`` plus the
+    activation floor, wire bytes and modeled step seconds — ONE cost
+    model shared with ``python -m apex_tpu.plan`` and ``pretrain_gpt
+    --plan auto``, no drift), and TRACES the planner's own ZeRO-3
+    feasibility program at the full shape (``plan.feasibility_step`` →
+    ``lint.trace.zero3_gather_hazards`` on the jaxpr: no allocation, no
+    compile) to prove it gathers per layer with no model-sized bulk
+    gather — the same program the ``plan`` audit tripwire walks.
+    ``param_state_report`` still rides along as the per-stage persistent
+    breakdown the table prints.
     """
+    from apex_tpu import plan as plan_mod
     from apex_tpu.lint import trace as lint_trace
     from apex_tpu.monitor.hbm import param_state_report
-    from apex_tpu.optimizers.distributed import gather_chunked_tree
 
-    cfg = GPTConfig(
-        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
-        num_attention_heads=heads, max_seq_len=seq, hidden_dropout=0.0,
-        axis=None, compute_dtype=jnp.bfloat16, remat=True)
-    model = GPTModel(cfg)
-    policy = amp.get_policy("O2")
-    abstract = jax.eval_shape(
-        lambda k: amp.cast_params(model.init(k), policy),
-        jax.random.PRNGKey(0))
-    report = param_state_report(abstract, dp)
+    spec = plan_mod.ModelSpec("gpt-2.7b-rung", vocab, hidden, layers,
+                              heads, seq)
+    report = param_state_report(plan_mod.abstract_params(spec), dp)
     n_params = report["param_count"]
 
-    mp_opt = amp.MixedPrecisionOptimizer(
-        FusedAdam(lr=1e-4), policy, zero_axis=mesh_lib.AXIS_DATA,
-        zero_level=3, gather_dtype="bf16")
-    meta = mp_opt.zero3_meta(abstract)
-    layer_meta = meta.subtree("layers")
-    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
-    toks = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+    stages = {
+        "replicated": plan_mod.Candidate(dp=dp),
+        "zero12": plan_mod.Candidate(dp=dp, zero_level=2,
+                                     gather_dtype="bf16"),
+        "zero3": plan_mod.Candidate(dp=dp, zero_level=3,
+                                    gather_dtype="bf16"),
+    }
+    placed, scores = {}, {}
+    for stage, cand in stages.items():
+        rec = plan_mod.score_candidate(spec, cand, hbm_bytes=hbm_bytes)
+        pred = rec["predicted"]
+        placed[stage] = bool(rec["feasible"])
+        scores[stage] = {
+            "feasible": rec["feasible"],
+            "rejected_by": rec.get("rejected_by"),
+            "hbm_bytes": pred["hbm_bytes"],
+            "residency_bytes": pred["hbm"]["residency"]["total_bytes"],
+            "comm_bytes_by_tier": pred["comm_bytes_by_tier"],
+            "bubble_floor": pred["bubble_floor"],
+            "step_seconds": pred["step_seconds"],
+        }
 
-    def zero3_loss(p, toks, tgts):
-        chunks = mp_opt.zero3_shard(p)
-        rest = gather_chunked_tree(
-            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
-        return model.loss(dict(rest, layers=chunks["layers"]), toks, tgts,
-                          layer_chunk_meta=layer_meta)
-
+    step = plan_mod.feasibility_step(spec, stages["zero3"])
     hz = lint_trace.zero3_gather_hazards(
-        jax.value_and_grad(zero3_loss), abstract, toks, toks,
-        axes={mesh_lib.AXIS_DATA: dp}, model_elems=n_params)
+        step["fn"], *step["args"], axes=step["axes"],
+        model_elems=step["model_elems"])
 
-    per_rank = report["per_rank"]
-    placed = {k: v["total_bytes"] < hbm_bytes for k, v in per_rank.items()}
     return {
         "config": {"dp": dp, "tp": 1, "pp": 1, "layers": layers,
                    "hidden": hidden, "heads": heads, "seq": seq,
@@ -444,15 +446,67 @@ def placement_rung(*, hidden=2560, layers=34, heads=32, vocab=50304,
         "param_state_report": report,
         "hbm_budget_bytes": int(hbm_bytes),
         "placed": placed,
+        "plan_scores": scores,
         "gather_census": {"hazard": hz["hazard"],
                           "layer_gathers": hz["layer_gathers"],
                           "bulk_gathers": hz["bulk_gathers"],
                           "min_model_elems": hz["min_model_elems"]},
-        "basis": ("analytic+trace: bytes from monitor.hbm."
-                  "param_state_report (persistent state only), census "
-                  "from lint.trace.zero3_gather_hazards on the "
-                  "full-shape jaxpr; this container cannot execute a "
-                  "2.7B-class step"),
+        "basis": ("analytic+trace: per-stage pricing from apex_tpu.plan."
+                  "score_candidate (sharded residency + activation "
+                  "floor), census from lint.trace.zero3_gather_hazards "
+                  "on plan.feasibility_step's full-shape jaxpr; this "
+                  "container cannot execute a 2.7B-class step"),
+    }
+
+
+def analytic_rung(*, model="gpt-13b", mesh=64,
+                  hbm_bytes=PLACEMENT_HBM_BYTES, micro_batch=1,
+                  num_microbatches=8):
+    """The planner-generated 13B-class rung: a full placement search at a
+    pod-slice mesh this container will never hold (mesh=64 — at mesh=8
+    the 13B optimizer chunks alone blow a 16 GiB budget, and 'needs more
+    chips' is itself the planner's verdict). Pure analysis — the row
+    records the winner's predicted anatomy and the rejection-provenance
+    histogram, not a timed run."""
+    from apex_tpu import plan as plan_mod
+
+    result = plan_mod.search(
+        model, mesh=mesh, hbm_bytes=hbm_bytes, micro_batch=micro_batch,
+        num_microbatches=num_microbatches)
+    winner = result["winner"]
+    by = {}
+    for r in result["rejected"]:
+        by[r["rejected_by"]] = by.get(r["rejected_by"], 0) + 1
+
+    def compact(rec):
+        c, p = rec["candidate"], rec["predicted"]
+        return {"candidate": c,
+                "hbm_bytes": p["hbm_bytes"],
+                "comm_bytes_by_tier": p["comm_bytes_by_tier"],
+                "bubble_floor": p["bubble_floor"],
+                "step_seconds": p["step_seconds"]}
+
+    wc = winner["candidate"] if winner else {}
+    return {
+        "config": {"analytic_rung": True, "model": model,
+                   "mesh": int(mesh),
+                   "dp": wc.get("dp", "-"), "tp": wc.get("tp", "-"),
+                   "pp": wc.get("pp", "-"),
+                   "layers": result["model"]["layers"],
+                   "zero_level": wc.get("zero_level", 0)},
+        "hbm_budget_bytes": int(hbm_bytes),
+        "global_rows": result["global_rows"],
+        "n_enumerated": result["n_enumerated"],
+        "n_ranked": len(result["ranked"]),
+        "rejected_by": by,
+        "winner": compact(winner) if winner else None,
+        "top": [compact(r) for r in result["ranked"][:5]],
+        "peak_source": result["peak_spec"].get("source"),
+        "ici_source": result["ici_spec"].get("source"),
+        "basis": ("analytic: apex_tpu.plan.search over the full "
+                  f"(dp,tp,pp,schedule,zero,wire) space at mesh={mesh}; "
+                  "ranked by modeled step seconds, rejections carry "
+                  "named provenance; no execution at this scale"),
     }
 
 
@@ -528,12 +582,20 @@ _TABLE_NOTES = {
         "collective-permute-start/done pairs with compute scheduled "
         "between them (benchmarks/overlap_evidence.py)."),
     "placement_rung": (
-        "the 2.7B-class row prices PERSISTENT per-rank residency "
-        "(monitor.hbm.param_state_report: working params + fp32 "
-        "master/moments, per ZeRO stage) against a 16 GiB HBM budget and "
-        "traces the fully-sharded step at the full shape for the "
-        "per-layer-gather census — analytic+trace evidence, not a timed "
-        "run (this container cannot execute that shape)."),
+        "the 2.7B-class row prices per-rank residency per ZeRO stage "
+        "through the planner's scorer (apex_tpu.plan.score_candidate — "
+        "the same cost model `python -m apex_tpu.plan` and `pretrain_gpt "
+        "--plan auto` rank with; sharded residency + activation floor "
+        "vs a 16 GiB HBM budget) and traces the planner's ZeRO-3 "
+        "feasibility program at the full shape for the per-layer-gather "
+        "census — analytic+trace evidence, not a timed run (this "
+        "container cannot execute that shape)."),
+    "analytic_rung": (
+        "the 13B-class row is a FULL planner search (apex_tpu.plan."
+        "search) at mesh=64: winner anatomy + rejection-provenance "
+        "histogram. At mesh=8 nothing places under 16 GiB — the 'needs "
+        "more chips' verdict is the point; pure analysis, no "
+        "execution."),
 }
 
 
@@ -544,8 +606,9 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
     config, gpt_scaling_test.py:53-57). One JSON artifact per (config,
     layers) when ``output_dir`` is set, plus a combined ``scaling_table``;
     returns the result rows. ``big_rung=True`` appends the 2.7B-class
-    :func:`placement_rung` row (analytic residency + full-shape gather
-    census) to the table. ``ledger`` appends one fingerprinted run
+    :func:`placement_rung` row (planner-scored residency + full-shape
+    gather census) and the 13B-class :func:`analytic_rung` row (full
+    planner search at mesh=64) to the table. ``ledger`` appends one fingerprinted run
     record per measured config row (apex_tpu.monitor.ledger) so sweep
     trajectories track across sessions."""
     def ledger_row(res):
@@ -647,6 +710,13 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
             name = (f"scaling_placement_dp{c['dp']}_h{c['hidden']}"
                     f"_l{c['layers']}_zero3.json")
             atomic_write_json(os.path.join(output_dir, name), res)
+        res13 = analytic_rung()
+        rows.append(res13)
+        print(json.dumps(res13), flush=True)
+        if output_dir:
+            c = res13["config"]
+            name = f"scaling_plan_{c['model']}_mesh{c['mesh']}.json"
+            atomic_write_json(os.path.join(output_dir, name), res13)
     if output_dir:
         # atomic (tmp + rename): a crash mid-sweep must never leave a
         # torn table for a later evidence consumer
@@ -673,6 +743,14 @@ def run_grid(*, hidden, layers_list, heads, vocab, seq, micro_batch, n_micro,
                   f"{c.get('cp', 1):>3} {sp_mark:>5} {c['layers']:>6} "
                   f"{'placed' if r['placed']['zero3'] else 'OVER':>9} "
                   f"{z3 / 2**30:>8.2f}G")
+        elif c.get("analytic_rung"):
+            w = r.get("winner")
+            verdict = "plan" if w else "no-fit"
+            hbm = (f"{w['hbm_bytes'] / 2**30:>8.2f}G" if w
+                   else f"{'-':>9}")
+            print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
+                  f"{c.get('cp', 1):>3} {'plan':>5} {c['layers']:>6} "
+                  f"{verdict:>9} {hbm}")
         elif "skipped" in r:
             print(f"{c['dp']:>3} {c['tp']:>3} {c['pp']:>3} "
                   f"{c.get('cp', 1):>3} {sp_mark:>5} "
@@ -705,8 +783,9 @@ def main():
     p.add_argument("--output-dir", type=str, default=None,
                    help="write one JSON artifact per config plus scaling_table.json")
     p.add_argument("--no-big-rung", action="store_true",
-                   help="skip the 2.7B-class placement rung (analytic "
-                        "residency + full-shape gather census)")
+                   help="skip the 2.7B-class placement rung and the "
+                        "13B-class planner rung (analytic residency + "
+                        "full-shape gather census + placement search)")
     p.add_argument("--ledger", nargs="?", const="out/ledger.jsonl",
                    default=None, metavar="PATH",
                    help="append one fingerprinted run record per measured "

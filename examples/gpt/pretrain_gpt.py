@@ -17,6 +17,7 @@ Run serial on one real TPU chip:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -47,6 +48,68 @@ from apex_tpu.parallel.distributed import (
 from apex_tpu.parallel.multiproc import initialize_distributed
 from apex_tpu.transformer import tensor_parallel as tp_mod
 from apex_tpu.transformer.pipeline_parallel import pipeline_specs, pipelined_loss_fn
+
+
+def _apply_plan(args):
+    """Run the static placement search (``apex_tpu.plan``, ISSUE 18) over
+    this run's model shape on the ambient device count and write the
+    winner's placement back onto ``args`` — the same knobs a human would
+    have passed. Prints ONE strict-JSON plan line; the winner's predicted
+    anatomy rides on ``args.plan_predicted`` so the ledger's predicted
+    block carries the planner's numbers (hbm/bubble/comm/step-seconds)
+    for the calibrate join."""
+    from apex_tpu import plan as plan_mod
+
+    spec = plan_mod.ModelSpec(
+        "pretrain_gpt", args.vocab, args.hidden, args.layers, args.heads,
+        args.seq, moe_experts=args.moe_experts or 0,
+        moe_top_k=args.moe_top_k)
+    result = plan_mod.search(
+        spec, mesh=len(jax.devices()), hbm_gb=args.plan_hbm_gb,
+        micro_batch=args.micro_batch,
+        num_microbatches=args.num_microbatches,
+        # this harness exposes no sequence-parallel or attention-window
+        # knobs — search only what it can express
+        constraints={"sp": False, "attention_window": None})
+    winner = result["winner"]
+    if winner is None:
+        by = {}
+        for r in result["rejected"]:
+            by[r["rejected_by"]] = by.get(r["rejected_by"], 0) + 1
+        raise SystemExit(
+            f"--plan auto: no feasible placement for this shape under "
+            f"{args.plan_hbm_gb} GiB/rank (rejected: {by}); raise "
+            f"--plan-hbm-gb or add devices")
+    c = winner["candidate"]
+    args.tp, args.pp = c["tp"], c["pp"]
+    if c["schedule"]:
+        args.pp_schedule = c["schedule"]
+        if c["schedule"] == "interleaved":
+            args.vpp = c["vpp"]
+    args.unroll = bool(c["unroll"])
+    args.zero = c["zero_level"] > 0
+    args.zero_level = c["zero_level"] or None
+    args.zero3_prefetch = c["zero3_prefetch"]
+    args.zero_gather = c["gather_dtype"]
+    args.reduce_dtype = c["reduce_dtype"]
+    if c["moe_expert_axis"]:
+        args.moe_dispatch_dtype = c["moe_dispatch_dtype"]
+    args.plan_predicted = winner["predicted"]
+    print(json.dumps({"plan": {
+        "winner": c,
+        "predicted": {
+            "hbm_bytes": winner["predicted"]["hbm_bytes"],
+            "comm_bytes_by_tier":
+                winner["predicted"]["comm_bytes_by_tier"],
+            "bubble_floor": winner["predicted"]["bubble_floor"],
+            "step_seconds": winner["predicted"]["step_seconds"],
+        },
+        "mesh": result["mesh"],
+        "hbm_budget_bytes": result["hbm_budget_bytes"],
+        "n_ranked": len(result["ranked"]),
+        "n_rejected": len(result["rejected"]),
+        "peak_source": result["peak_spec"]["source"],
+        "ici_source": result["ici_spec"]["source"]}}))
 
 
 def parse_args():
@@ -130,6 +193,17 @@ def parse_args():
                         "all_to_all wire to 1 B/elem + fp32 per-block "
                         "scales (parallel/quantize.quantized_all_to_all; "
                         "needs --moe-experts and dp > 1)")
+    p.add_argument("--plan", default=None, metavar="auto",
+                   help="'auto': run the static placement search "
+                        "(apex_tpu.plan) over THIS model shape on the "
+                        "ambient device count and adopt the winner's "
+                        "placement (tp/pp/schedule/zero/prefetch/wire/"
+                        "unroll knobs overridden; one JSON plan line is "
+                        "printed; the winner's predicted anatomy seeds "
+                        "the ledger's predicted block)")
+    p.add_argument("--plan-hbm-gb", type=float, default=16.0,
+                   help="per-rank HBM budget the --plan search prices "
+                        "candidates against (GiB)")
     p.add_argument("--data", default=None, help="dir of .bin int32 token files")
     p.add_argument("--save-dir", default=None)
     p.add_argument("--save-every", type=int, default=100)
@@ -164,6 +238,10 @@ def parse_args():
                         "last loss-scale state. Default PATH: "
                         "<journal>.flight.json")
     args = p.parse_args()
+    if args.plan:
+        if args.plan != "auto":
+            p.error("--plan accepts 'auto' (the static placement search)")
+        _apply_plan(args)
     if not args.ledger and os.environ.get("APEX_TPU_LEDGER"):
         args.ledger = os.environ["APEX_TPU_LEDGER"]
     if args.flight == "auto":
@@ -449,6 +527,16 @@ def main():
                   "moe_experts": args.moe_experts or 0,
                   "moe_dispatch_dtype": args.moe_dispatch_dtype or "none"}
     ledger_pred = {}  # predicted block, filled at arm time (off-TPU math)
+    if getattr(args, "plan_predicted", None):
+        # the planner's predicted anatomy seeds the ledger keys the
+        # calibrate join reads; traced statics (journal arming below)
+        # overwrite the comm figure with the booked census when available
+        pred = args.plan_predicted
+        ledger_pred.setdefault("hbm_peak_bytes", pred["hbm_bytes"])
+        ledger_pred.setdefault("bubble_floor", pred["bubble_floor"])
+        ledger_pred.setdefault("comm_bytes_per_step",
+                               pred["comm_bytes_by_tier"]["ici"])
+        ledger_pred.setdefault("modeled_step_s", pred["step_seconds"])
     journal = forensics = None
     if args.journal:
         from apex_tpu.monitor import (

@@ -405,3 +405,152 @@ def test_audit_step_program_reports_injected_hazard():
                                             label="injected")
     assert not verdict["ok"]
     assert verdict["passes"]["collective-consistency"]["findings"]
+
+
+# ---------------------------------------------------------------------------
+# plan-feasibility: planner claim vs traced step (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def _tiny_plan_spec():
+    from apex_tpu import plan as plan_mod
+
+    return plan_mod.ModelSpec("lintir-tiny", 128, 64, 4, 4, 32)
+
+
+def test_plan_feasibility_clean_on_planner_zero3_steps(_tiny_plan_spec):
+    """Both ZeRO-3 drives the planner can emit (scan + remat, unrolled +
+    prefetch) trace to per-layer gathers — the pass stays silent and the
+    census shows the gather anatomy it checked."""
+    from apex_tpu import plan as plan_mod
+
+    for cand in (plan_mod.Candidate(dp=4, zero_level=3),
+                 plan_mod.Candidate(dp=4, zero_level=3, zero3_prefetch=1,
+                                    unroll=True)):
+        step = plan_mod.feasibility_step(_tiny_plan_spec, cand)
+        sir = lint_ir.trace_ir(step["fn"], *step["args"],
+                               axes=step["axes"])
+        res = lint_ir.run_passes(
+            sir, passes=["plan-feasibility"],
+            options={"plan-feasibility": {
+                "plan": step["plan"],
+                "model_elems": step["model_elems"]}})
+        r = res["passes"]["plan-feasibility"]
+        assert res["ok"], r
+        assert r["audited"] and not r["findings"]
+        z3 = r["census"]["zero3_gather"]
+        assert not z3["hazard"] and z3["layer_gathers"] > 0
+
+
+def test_plan_feasibility_flags_bulk_gather_claimed_as_zero3(
+        _tiny_plan_spec):
+    """A step that gathers the whole layer stack up front (the
+    O(model)-rematerialization class) contradicts a ZeRO-3 score: the
+    pass adopts the zero3-bulk-gather finding under its own rule with
+    the plan claim attached. Without a plan option the pass is inert."""
+    import jax.numpy as jnp
+
+    from apex_tpu import amp, plan as plan_mod
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.distributed import (
+        gather_chunked_tree,
+        gather_stacked_leaf,
+    )
+    from apex_tpu.plan.search import abstract_params, model_config_kwargs
+
+    spec = _tiny_plan_spec
+    kw = model_config_kwargs(spec)
+    kw.update(remat=True)
+    model = GPTModel(GPTConfig(**kw))
+    abstract = abstract_params(spec)
+    mp3 = amp.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-4), amp.get_policy("O2"), zero_axis="data",
+        zero_level=3)
+    meta = mp3.zero3_meta(abstract)
+    layer_meta = meta.subtree("layers")
+    rest_meta = meta.select([k for k in meta.shapes if k != "layers"])
+    toks = jax.ShapeDtypeStruct((1, spec.seq), jnp.int32)
+
+    def bulk_loss(p, toks, tgts):
+        chunks = mp3.zero3_shard(p)
+        rest = gather_chunked_tree(
+            {k: v for k, v in chunks.items() if k != "layers"}, rest_meta)
+        layers = jax.tree.map(
+            lambda c, s: gather_stacked_leaf(c, s.shape, s.dtype,
+                                             meta.axis),
+            chunks["layers"], layer_meta.shapes,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        return model.loss(dict(rest, layers=layers), toks, tgts)
+
+    step = plan_mod.feasibility_step(
+        spec, plan_mod.Candidate(dp=4, zero_level=3))
+    sir = lint_ir.trace_ir(jax.value_and_grad(bulk_loss), abstract, toks,
+                           toks, axes={"data": 4})
+    res = lint_ir.run_passes(
+        sir, passes=["plan-feasibility"],
+        options={"plan-feasibility": {"plan": step["plan"],
+                                      "model_elems": step["model_elems"]}})
+    r = res["passes"]["plan-feasibility"]
+    assert not res["ok"] and r["findings"]
+    f = r["findings"][0]
+    assert f["rule"] == "plan-feasibility"
+    assert "plan scored as" in f["message"]
+    assert f["plan_claim"].startswith("ZeRO-3")
+    # inert without the plan option: not every audited program is planned
+    inert = lint_ir.run_passes(sir, passes=["plan-feasibility"])
+    assert inert["ok"]
+    assert inert["passes"]["plan-feasibility"] == {
+        "findings": [], "audited": False, "census": {}}
+
+
+def test_plan_feasibility_moe_dispatch_both_ways():
+    """The expert-parallel claim: the planner's EP step carries its
+    dispatch all_to_alls (silent); a serial-expert step scored as EP
+    fires the adopted moe-dispatch finding."""
+    import jax.numpy as jnp
+
+    from apex_tpu import plan as plan_mod
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.plan.search import model_config_kwargs
+
+    spec = plan_mod.ModelSpec("lintir-tinymoe", 128, 64, 4, 4, 32,
+                              moe_experts=4)
+    cand = plan_mod.Candidate(dp=4, moe_expert_axis="data",
+                              moe_dispatch_dtype="int8")
+    step = plan_mod.feasibility_step(spec, cand)
+    sir = lint_ir.trace_ir(step["fn"], *step["args"], axes=step["axes"])
+    opts = {"plan-feasibility": {"plan": step["plan"],
+                                 "model_elems": step["model_elems"]}}
+    r = lint_ir.run_passes(sir, passes=["plan-feasibility"],
+                           options=opts)["passes"]["plan-feasibility"]
+    assert r["audited"] and not r["findings"], r
+
+    kw = model_config_kwargs(spec)
+    kw.update(remat=True)
+    serial = GPTModel(GPTConfig(**kw))
+    full = jax.eval_shape(serial.init, jax.random.PRNGKey(0))
+    toks = jax.ShapeDtypeStruct((1, spec.seq), jnp.int32)
+    sir_s = lint_ir.trace_ir(
+        jax.value_and_grad(lambda p, a, b: serial.loss(p, a, b)),
+        full, toks, toks, axes={"data": 4})
+    rs = lint_ir.run_passes(sir_s, passes=["plan-feasibility"],
+                            options=opts)["passes"]["plan-feasibility"]
+    assert rs["findings"]
+    assert "all_to_all" in rs["findings"][0]["message"]
+    assert rs["findings"][0]["plan_claim"].startswith("expert-parallel")
+
+
+def test_audit_plan_program_runs_clean():
+    """The registered `plan` audit program: search a tiny spec, trace the
+    winner's feasibility step, and the plan-feasibility pass must audit
+    it (not skip) and find nothing."""
+    from apex_tpu.lint import audit as lint_audit
+
+    verdict = lint_audit.run_audit(programs=("plan",))
+    assert verdict["all_ok"], verdict
+    prog = verdict["programs"]["plan"]
+    pf = prog["passes"]["plan-feasibility"]
+    assert pf["audited"] and not pf["findings"]
+    assert pf["plan"]["zero_level"] == 3
