@@ -63,7 +63,15 @@ COMM_SCOPE_HELPERS = ("_comm", "collective_scope",
                       "quantized_psum_scatter",
                       "quantized_all_gather",
                       "quantized_gather_chunk",
-                      "quantized_all_to_all")
+                      "quantized_all_to_all",
+                      # two-tier hierarchical collectives
+                      # (parallel/hierarchy.py): each hop runs under its
+                      # own comm: scope, booked per tier
+                      "hier_psum",
+                      "hier_pmean",
+                      "hier_scatter_chunk",
+                      "hier_gather_chunk",
+                      "hier_all_to_all")
 
 # The jaxpr-level decomposition contract of sequence parallelism (read
 # statically by apex_tpu.lint.trace.sequence_parallel_hazards, like the

@@ -1,0 +1,264 @@
+"""Two-tier (DCN x ICI) hierarchical collectives — the pod-scale wire.
+
+Reference: apex's contrib DistributedFusedAdam splits its gradient
+reduction into an intra-group reduce-scatter followed by an inter-group
+all-reduce over a second, smaller process group
+(distributed_fused_adam.py:397-441 ``_pipeline_block_reductions``, with
+``dwu_group_size`` carving the nodes into reduction groups) — the classic
+hierarchical decomposition that keeps the bulk of the traffic on the fast
+intra-node links and ships exactly one pre-reduced shard across the slow
+tier. Here the same decomposition is spelled over TWO named mesh axes:
+
+    ``ici_axis``  — the island-internal axis (fast ICI links),
+    ``dcn_axis``  — the inter-island axis (slow DCN links, the leading
+                    mesh dimension of ``mesh.make_virtual_mesh(islands=)``).
+
+Every bulk collective over the combined ``(dcn, ici)`` group factors into
+intra-island reduce -> ONE inter-island exchange of the 1/ici-sized shard
+-> intra-island broadcast, so the DCN tier only ever carries ``1/n_ici``
+of the payload. Each hop runs under its own ``comm:`` scope, so
+``monitor.comms.CommAccount.by_tier()`` books the tiers separately —
+the "DCN moves 1/n_ici of the bytes" claim is a reported number.
+
+The inter-island hop optionally rides the 1-byte quantized wire
+(``parallel/quantize.py`` — EQuARX's deployment point, PAPERS.md: blockwise
+quantized all-reduce exactly where the slow tier binds). The quantized
+gradient hop carries the same error-feedback residual contract as
+``quantized_reduce_scatter``; values stay exact when ``wire_dtype=None``.
+
+Equivalence contract (pinned by tests/test_hierarchy.py, values AND
+grads): each ``hier_*`` collective computes the SAME function as its flat
+counterpart over the tuple axis ``(dcn_axis, ici_axis)`` — lax orders a
+tuple-axis group with the first name most significant, so the flat chunk
+index of rank ``(d, i)`` is ``d * n_ici + i``, and the stage/transpose
+arithmetic below reproduces exactly that layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.monitor.comms import collective_scope as _comm
+
+#: every verb in this module must run under a ``comm:`` scope (the lint
+#: comm-scope rule; the marker opts the file in even if imports change)
+LINT_COMM_SCOPE = True
+
+#: The hierarchical-decomposition contract (read statically by
+#: apex_tpu.lint.trace.flat_dcn_collective_hazards, like the contract
+#: constants in parallel/collectives.py): in a step whose bulk gradient
+#: traffic spans the DCN tier, every bulk reduce primitive must bind ONE
+#: mesh axis — the intra-island stage on the ICI axis, the inter-island
+#: stage on the DCN axis. A single flat collective binding a DCN axis
+#: TOGETHER with another axis ships the full payload across the slow
+#: tier (no intra-island pre-reduction) and is the hazard.
+HIERARCHY_DECOMPOSED_PRIMS = ("psum_scatter", "all_gather", "all_to_all")
+
+
+def _tier_sizes(dcn_axis: str, ici_axis: str) -> Tuple[int, int]:
+    return lax.axis_size(dcn_axis), lax.axis_size(ici_axis)
+
+
+def hier_psum(tree: Any, dcn_axis: str, ici_axis: str,
+              wire_dtype: Optional[str] = None) -> Any:
+    """All-reduce-sum over the combined ``(dcn, ici)`` group, decomposed:
+    intra-island reduce-scatter -> inter-island all-reduce of the
+    1/n_ici shard -> intra-island all-gather. Same value (and gradient —
+    every stage is the exact adjoint of its inverse) as
+    ``lax.psum(tree, (dcn_axis, ici_axis))``; the DCN tier carries only
+    the pre-reduced shard. ``wire_dtype`` quantizes the inter-island hop
+    (reduce-scatter + all-gather pair at 1 B/elem, parallel/quantize.py);
+    activations are fresh each step, so no residual is carried — the
+    quantized form is NOT differentiable (the encode's round would zero
+    the cotangents) and is for gradient/state transport only."""
+    from apex_tpu.parallel.quantize import (
+        quantized_all_gather,
+        quantized_psum_scatter,
+    )
+
+    def _leaf(x):
+        n_d, n_i = _tier_sizes(dcn_axis, ici_axis)
+        flat = _flat_padded_f32(x, n_d * n_i)
+        with _comm("psum_scatter", ici_axis, flat):
+            chunk = lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
+                                     tiled=True)
+        if n_d > 1:
+            if wire_dtype is None:
+                with _comm("psum", dcn_axis, chunk):
+                    chunk = lax.psum(chunk, dcn_axis)
+            else:
+                part = quantized_psum_scatter(chunk, dcn_axis, wire_dtype,
+                                              scatter_dim=0)
+                chunk = quantized_all_gather(part, dcn_axis, wire_dtype,
+                                             gather_dim=0)
+        with _comm("all_gather", ici_axis, chunk):
+            full = lax.all_gather(chunk, ici_axis, axis=0, tiled=True)
+        return full[:x.size].reshape(x.shape).astype(x.dtype)
+
+    return jax.tree.map(_leaf, tree)
+
+
+def hier_pmean(tree: Any, dcn_axis: str, ici_axis: str,
+               wire_dtype: Optional[str] = None) -> Any:
+    """Averaging hierarchical all-reduce — the DDP gradient-reduction
+    semantic of ``collectives.pmean`` over the combined group."""
+    def _avg(x):
+        n_d, n_i = _tier_sizes(dcn_axis, ici_axis)
+        return x / (n_d * n_i)
+
+    return jax.tree.map(_avg, hier_psum(tree, dcn_axis, ici_axis,
+                                        wire_dtype=wire_dtype))
+
+
+def _flat_padded_f32(x: jax.Array, n: int) -> jax.Array:
+    from apex_tpu.optimizers.distributed import _flat_padded
+
+    return _flat_padded(x.astype(jnp.float32), n)
+
+
+def hier_scatter_chunk(
+    x: jax.Array,
+    dcn_axis: str,
+    ici_axis: str,
+    *,
+    wire_dtype: Optional[str] = None,
+    residual: Optional[jax.Array] = None,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Hierarchical ZeRO grad reduce-scatter: sum-reduce ``x`` over the
+    combined ``(dcn, ici)`` group into this rank's 1-D chunk — the
+    two-tier form of ``optimizers.distributed.scatter_chunk`` over the
+    tuple axis (same flatten/pad/chunk layout: rank ``(d, i)`` ends with
+    flat chunk ``d * n_ici + i``; same SUM semantics — callers divide by
+    the group size for averaging).
+
+    Stage 1 (ICI): the padded payload, re-blocked destination-ici-major,
+    reduce-scatters over the island — each rank ends with the
+    island-reduced rows destined to its ici position, ``1/n_ici`` of the
+    payload. Stage 2 (DCN): ONE inter-island reduce-scatter of those rows
+    — exact fp32, or the quantized encoded-all_to_all pair
+    (``quantized_reduce_scatter``) at 1 B/elem when ``wire_dtype`` is set.
+    ``residual`` is the error-feedback state for the quantized DCN hop
+    ONLY (length ``n_dcn * chunk`` — the intra-island stage stays exact
+    fp32 and needs none); returns ``(sum_chunk, new_residual)``.
+    """
+    from apex_tpu.parallel.quantize import quantized_reduce_scatter
+
+    n_d, n_i = _tier_sizes(dcn_axis, ici_axis)
+    flat = _flat_padded_f32(x, n_d * n_i)
+    m = flat.size // (n_d * n_i)
+    # destination-ici-major re-block: row i of the staged payload holds
+    # the n_dcn blocks destined to island position i, so the intra-island
+    # scatter lands each rank exactly the rows its island must pre-reduce
+    staged = flat.reshape(n_d, n_i, m).transpose(1, 0, 2).reshape(-1)
+    with _comm("psum_scatter", ici_axis, staged):
+        island = lax.psum_scatter(staged, ici_axis, scatter_dimension=0,
+                                  tiled=True)  # (n_d * m,), island-reduced
+    if wire_dtype is None:
+        if residual is not None:
+            raise ValueError("residual is error-feedback state for the "
+                             "quantized DCN hop; exact wire carries none")
+        with _comm("psum_scatter", dcn_axis, island):
+            chunk = lax.psum_scatter(island, dcn_axis, scatter_dimension=0,
+                                     tiled=True)
+        return chunk, None
+    return quantized_reduce_scatter(island, n_d, dcn_axis, wire_dtype,
+                                    residual=residual, key=key)
+
+
+def hier_gather_chunk(
+    chunk: jax.Array,
+    shape,
+    dtype,
+    dcn_axis: str,
+    ici_axis: str,
+    *,
+    gather_dtype: Optional[Any] = None,
+    dcn_wire: Optional[str] = None,
+) -> jax.Array:
+    """Hierarchical ZeRO param all-gather — the two-tier inverse of
+    :func:`hier_scatter_chunk` and the decomposed form of
+    ``optimizers.distributed.gather_leaf`` over the tuple axis: ONE
+    inter-island gather of this rank's chunk (the small hop — ``1/n_ici``
+    of the leaf crosses DCN), then an intra-island gather rebuilding the
+    full leaf, transposed back to the flat ``d * n_ici + i`` chunk order.
+
+    ``gather_dtype`` casts the payload BEFORE the collectives (the bf16
+    compressed-gather wire of gather_leaf — each chunk element is cast
+    exactly once, so the result bit-matches the flat gather). ``dcn_wire``
+    ("int8"/"e5m2") instead quantizes the inter-island hop at a per-chunk
+    scale (``quantized_all_gather``) and runs the intra-island hop at
+    ``gather_dtype``/the leaf dtype — every rank decodes the same view,
+    so ranks cannot diverge."""
+    from apex_tpu.parallel.quantize import quantized_all_gather
+
+    n_d, n_i = _tier_sizes(dcn_axis, ici_axis)
+    n_elems = 1
+    for s in shape:
+        n_elems *= s
+    wire = jnp.dtype(gather_dtype if gather_dtype is not None else dtype)
+    if dcn_wire is not None:
+        rows = quantized_all_gather(
+            chunk.astype(jnp.float32), dcn_axis, dcn_wire, gather_dim=0)
+        rows = rows.reshape(n_d, -1).astype(wire)
+    else:
+        payload = chunk.astype(wire)
+        with _comm("all_gather", dcn_axis, payload):
+            rows = lax.all_gather(payload, dcn_axis, axis=0, tiled=False)
+    with _comm("all_gather", ici_axis, rows):
+        full = lax.all_gather(rows, ici_axis, axis=0, tiled=False)
+    flat = full.transpose(1, 0, 2).reshape(-1)
+    return flat[:n_elems].reshape(shape).astype(dtype)
+
+
+def hier_all_to_all(
+    x: jax.Array,
+    dcn_axis: str,
+    ici_axis: str,
+    *,
+    split_axis: int,
+    concat_axis: int,
+    dcn_wire: Optional[str] = None,
+) -> jax.Array:
+    """Two-hop all-to-all over the combined ``(dcn, ici)`` group — the
+    hierarchical MoE dispatch (transformer/moe.py): blocks first exchange
+    WITHIN each island (fast ICI hop, re-bucketing so every rank holds
+    exactly the blocks its island position must forward), then ONE
+    all_to_all per island crosses the DCN tier. Output shape, placement,
+    and gradient match ``lax.all_to_all(x, (dcn_axis, ici_axis),
+    split_axis=, concat_axis=, tiled=True)`` exactly — received blocks
+    concatenate in flat ``d * n_ici + i`` sender order.
+
+    ``dcn_wire`` quantizes ONLY the inter-island hop
+    (``quantized_all_to_all`` — per-destination-block scales, custom-VJP
+    backward re-quantized, so a training step moves 1 B/elem across DCN
+    in both directions while the intra-island hop stays full-precision).
+    """
+    from apex_tpu.parallel.quantize import (
+        _merge_blocks,
+        _split_blocks,
+        quantized_all_to_all,
+    )
+
+    n_d, n_i = _tier_sizes(dcn_axis, ici_axis)
+    xb = _split_blocks(x, n_d * n_i, split_axis)  # (n, ...), dest-major
+    xb = xb.reshape((n_d, n_i) + xb.shape[1:])
+    xb = jnp.swapaxes(xb, 0, 1)  # (n_i, n_d, ...): dest-ici leading
+    with _comm("all_to_all", ici_axis, xb):
+        xb = lax.all_to_all(xb, ici_axis, split_axis=0, concat_axis=0,
+                            tiled=True)  # [src_i, dest_d, ...]
+    xb = jnp.swapaxes(xb, 0, 1)  # (n_d, n_i, ...): dest-island leading
+    if dcn_wire is None:
+        with _comm("all_to_all", dcn_axis, xb):
+            xb = lax.all_to_all(xb, dcn_axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+    else:
+        xb = quantized_all_to_all(xb, dcn_axis, dcn_wire,
+                                  split_axis=0, concat_axis=0)
+    # [src_d, src_i, ...] = sender (src_d, src_i)'s block for this rank
+    xb = xb.reshape((n_d * n_i,) + xb.shape[2:])
+    return _merge_blocks(xb, concat_axis)

@@ -1,0 +1,496 @@
+"""Two-tier hierarchical collectives (parallel/hierarchy.py).
+
+The equivalence contract: every hier_* collective computes the SAME
+function — values AND gradients — as its flat counterpart over the tuple
+axis ``(dcn, ici)`` on a simulated two-host mesh (2 islands x 4 devices).
+Integer-valued fp32 payloads make the sums association-free, so "same"
+is bit-exact, not a tolerance. The per-tier accounting claims (DCN hop =
+1/n_ici of the payload; int8 wire = exactly 1/4 the fp32 DCN bytes) are
+pinned off CommAccount.by_tier()/by_verb_dtype().
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.monitor import comms
+from apex_tpu.optimizers.distributed import gather_leaf, scatter_chunk
+from apex_tpu.parallel import hierarchy
+
+N_DCN = 2
+N_ICI = 4
+AXES = ("dcn", "data")
+
+
+@pytest.fixture
+def mesh():
+    devs = np.array(jax.devices()[:N_DCN * N_ICI]).reshape(N_DCN, N_ICI)
+    return Mesh(devs, AXES)
+
+
+def _int_valued(key, shape):
+    """Integer-valued fp32: float sums are exact regardless of
+    association, so hierarchical == flat is bit-exact."""
+    return jax.random.randint(key, shape, -8, 9).astype(jnp.float32)
+
+
+def _smap(mesh, fn, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+# ---------------------------------------------------------------------------
+# psum / pmean
+# ---------------------------------------------------------------------------
+
+
+def test_hier_psum_matches_flat_values_and_grads(mesh):
+    x = _int_valued(jax.random.PRNGKey(0), (N_DCN * N_ICI, 6, 5))
+    w = _int_valued(jax.random.PRNGKey(1), (6, 5))
+    shard = P(AXES)
+
+    def flat(w, x):
+        with comms.collective_scope("psum", AXES, x):
+            y = lax.psum(w * x, AXES)
+        return jnp.sum(y * x)
+
+    def hier(w, x):
+        y = hierarchy.hier_psum(w * x, "dcn", "data")
+        return jnp.sum(y * x)
+
+    for fn in (flat, hier):
+        fn.__name__ = fn.__name__  # keep names for failure messages
+    run_flat = _smap(mesh, flat, (P(), shard), P())
+    run_hier = _smap(mesh, hier, (P(), shard), P())
+    # the per-rank losses differ (x is sharded): compare per-rank outputs
+    # by keeping the loss local — grads are the real target here
+    lf, gf = jax.value_and_grad(lambda w: jnp.sum(run_flat(w, x)))(w)
+    lh, gh = jax.value_and_grad(lambda w: jnp.sum(run_hier(w, x)))(w)
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(lh))
+    np.testing.assert_array_equal(np.asarray(gf), np.asarray(gh))
+
+    # pmean: same decomposition, averaged
+    def mean_hier(x):
+        return hierarchy.hier_pmean(x, "dcn", "data")
+
+    def mean_flat(x):
+        with comms.collective_scope("pmean", AXES, x):
+            return lax.pmean(x, AXES)
+
+    out_h = _smap(mesh, mean_hier, (shard,), shard)(x)
+    out_f = _smap(mesh, mean_flat, (shard,), shard)(x)
+    np.testing.assert_array_equal(np.asarray(out_h), np.asarray(out_f))
+
+
+# ---------------------------------------------------------------------------
+# reduce-scatter / all-gather (the ZeRO chunk pair)
+# ---------------------------------------------------------------------------
+
+
+def test_hier_scatter_chunk_matches_flat(mesh):
+    n = N_DCN * N_ICI
+    # 103 elements: exercises the zero-padding path too
+    x = _int_valued(jax.random.PRNGKey(2), (n, 103))
+    shard = P(AXES)
+
+    def flat(x):
+        return scatter_chunk(x, n, AXES)
+
+    def hier(x):
+        chunk, _ = hierarchy.hier_scatter_chunk(x, "dcn", "data")
+        return chunk
+
+    universal = P(AXES)
+    out_f = _smap(mesh, flat, (shard,), universal)(x)
+    out_h = _smap(mesh, hier, (shard,), universal)(x)
+    np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_h))
+
+
+def test_hier_gather_chunk_bitmatches_flat(mesh):
+    n = N_DCN * N_ICI
+    shape = (13, 8)  # 104 elements -> chunk 13, no padding loss
+    full = _int_valued(jax.random.PRNGKey(3), shape) / 4.0
+    universal = P(AXES)
+
+    def slice_chunks(x):
+        from apex_tpu.optimizers.distributed import local_chunk
+
+        idx = lax.axis_index("dcn") * N_ICI + lax.axis_index("data")
+        return local_chunk(x, n, idx)
+
+    chunks = _smap(mesh, slice_chunks, (P(),), universal)(full)
+
+    for gd in (None, jnp.bfloat16):
+        def flat(c):
+            return gather_leaf(c, shape, jnp.float32, AXES, gather_dtype=gd)
+
+        def hier(c):
+            return hierarchy.hier_gather_chunk(
+                c, shape, jnp.float32, "dcn", "data", gather_dtype=gd)
+
+        out_f = _smap(mesh, flat, (universal,), P())(chunks)
+        out_h = _smap(mesh, hier, (universal,), P())(chunks)
+        np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_h))
+    # exact wire round-trips the original
+    np.testing.assert_array_equal(
+        np.asarray(_smap(mesh, lambda c: hierarchy.hier_gather_chunk(
+            c, shape, jnp.float32, "dcn", "data"),
+            (universal,), P())(chunks)),
+        np.asarray(full))
+
+
+# ---------------------------------------------------------------------------
+# all-to-all (the two-hop MoE dispatch)
+# ---------------------------------------------------------------------------
+
+
+def test_hier_all_to_all_matches_flat_values_and_grads(mesh):
+    n = N_DCN * N_ICI
+    # local (per-rank) payload (1, 3n, 5): split dim 1 into n blocks of 3,
+    # concatenate received blocks on dim 2 — the general reshard shape
+    x = _int_valued(jax.random.PRNGKey(4), (n, n * 3, 5))
+    c = _int_valued(jax.random.PRNGKey(5), (n, 3, 5 * n))
+    shard = P(AXES)
+
+    def flat(x, c):
+        with comms.collective_scope("all_to_all", AXES, x):
+            y = lax.all_to_all(x, AXES, split_axis=1, concat_axis=2,
+                               tiled=True)
+        return jnp.sum(y * c)
+
+    def hier(x, c):
+        y = hierarchy.hier_all_to_all(x, "dcn", "data",
+                                      split_axis=1, concat_axis=2)
+        return jnp.sum(y * c)
+
+    run_flat = _smap(mesh, flat, (shard, shard), P())
+    run_hier = _smap(mesh, hier, (shard, shard), P())
+    lf, gf = jax.value_and_grad(lambda x: jnp.sum(run_flat(x, c)))(x)
+    lh, gh = jax.value_and_grad(lambda x: jnp.sum(run_hier(x, c)))(x)
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(lh))
+    np.testing.assert_array_equal(np.asarray(gf), np.asarray(gh))
+
+
+# ---------------------------------------------------------------------------
+# per-tier accounting: DCN hop carries 1/n_ici; int8 wire is exactly 1/4
+# ---------------------------------------------------------------------------
+
+
+def _census(fn, *args):
+    with comms.comm_accounting() as acct:
+        jax.make_jaxpr(
+            lambda *a: jax.shard_map(
+                fn,
+                mesh=Mesh(np.array(jax.devices()[:N_DCN * N_ICI]).reshape(
+                    N_DCN, N_ICI), AXES),
+                in_specs=tuple(P(AXES) for _ in args), out_specs=P(AXES),
+                check_vma=False)(*a))(*args)
+    return acct
+
+
+def test_dcn_tier_booking_and_int8_quarter_bytes():
+    n = N_DCN * N_ICI
+    x = jnp.zeros((n, 128), jnp.float32)
+
+    def exact(x):
+        chunk, _ = hierarchy.hier_scatter_chunk(x, "dcn", "data")
+        return chunk
+
+    def quant(x):
+        chunk, _ = hierarchy.hier_scatter_chunk(x, "dcn", "data",
+                                                wire_dtype="int8")
+        return chunk
+
+    a_exact = _census(exact, x)
+    a_quant = _census(quant, x)
+
+    local = x.size // n  # bookings are per-rank payloads (local shapes)
+    tiers = a_exact.by_tier()
+    # per-rank payload: ici stage ships the full padded local leaf, the
+    # dcn stage exactly 1/n_ici of it
+    assert tiers["ici"]["bytes"] == local * 4
+    assert tiers["dcn"]["bytes"] == local * 4 // N_ICI
+
+    # int8 wire: the bulk DCN payload is exactly 1/4 the fp32 bytes; the
+    # fp32 scale side-channel is booked separately (by_verb_dtype rows)
+    dcn_rows = a_quant.by_verb_dtype(axis="dcn")
+    assert dcn_rows["all_to_all[int8]"]["bytes"] == local // N_ICI
+    assert dcn_rows["all_to_all[int8]"]["bytes"] * 4 == \
+        a_exact.by_tier()["dcn"]["bytes"]
+    # the side-channel is n_dcn fp32 scales — negligible next to the bulk
+    assert dcn_rows["all_to_all[float32]"]["bytes"] == N_DCN * 4
+    # the ici stage is identical (and full-precision) in both programs
+    assert a_quant.by_tier()["ici"]["bytes"] == local * 4
+
+
+def test_moe_two_hop_dispatch_matches_single_hop(mesh):
+    """MoEMLP(dcn_axis=...): the two-hop hierarchical dispatch computes
+    the same function — output AND gradients, bit-exact — as the flat
+    single-hop all_to_all over the tuple expert group (only the exchange
+    differs between the paths, and hier_all_to_all is bit-exact)."""
+    from apex_tpu.transformer.moe import MoEMLP
+
+    n = N_DCN * N_ICI
+    kw = dict(hidden_size=16, ffn_hidden_size=32, num_experts=8,
+              top_k=2, capacity_factor=2.0)
+    flat = MoEMLP(expert_axis=AXES, **kw)
+    hier = MoEMLP(expert_axis="data", dcn_axis="dcn", **kw)
+    # identical param placement: both shard the expert dim over the full
+    # (dcn, data) group (specs() spells it as the tuple entry)
+    params = flat.init(jax.random.PRNGKey(13))
+    pspecs = flat.specs()
+    assert pspecs == hier.specs()
+    h = jax.random.normal(jax.random.PRNGKey(14), (n, 4, 16))
+    c = jax.random.normal(jax.random.PRNGKey(15), (n, 4, 16))
+    shard = P(AXES)
+
+    def run(moe):
+        def fwd(params, h, c):
+            out, aux = moe.apply_expert_parallel(params, h)
+            return jnp.sum(out * c) + aux["load_balancing_loss"]
+
+        step = _smap(mesh, fwd, (pspecs, shard, shard), P())
+        return jax.value_and_grad(
+            lambda p, h: jnp.sum(step(p, h, c)), argnums=(0, 1))(params, h)
+
+    (lf, (gpf, ghf)) = run(flat)
+    (lh, (gph, ghh)) = run(hier)
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(lh))
+    np.testing.assert_array_equal(np.asarray(ghf), np.asarray(ghh))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), gpf, gph)
+
+
+def test_zero_step_dcn_axis_bitmatches_flat_group(mesh):
+    """MixedPrecisionOptimizer(dcn_axis=..., dcn_wire=None): the whole
+    sharded step — chunk init, scatter, Adam update, gather — bit-matches
+    the flat optimizer over the tuple axis (integer-valued grads make the
+    scatter sums exact, so identical chunks drive identical updates)."""
+    from apex_tpu import amp as amp_mod
+    from apex_tpu.optimizers import FusedAdam
+
+    params = {"w": _int_valued(jax.random.PRNGKey(7), (7, 5)) / 4.0,
+              "b": _int_valued(jax.random.PRNGKey(8), (13,)) / 8.0}
+    n = N_DCN * N_ICI
+    grads = {"w": _int_valued(jax.random.PRNGKey(9), (n, 7, 5)),
+             "b": _int_valued(jax.random.PRNGKey(10), (n, 13))}
+    policy = amp_mod.get_policy("O2")
+
+    def run(mp_opt):
+        def step(p, gw, gb):
+            st = mp_opt.init(p)
+            # scaled grads: each rank's own slice (leading dim sharded)
+            g = {"w": gw[0] * st.scaler.loss_scale,
+                 "b": gb[0] * st.scaler.loss_scale}
+            new_p, new_st, metrics = mp_opt.apply_gradients(st, p, g)
+            return new_p, new_st.master, metrics["loss_scale"]
+
+        fn = _smap(mesh, step, (P(), P(AXES), P(AXES)),
+                   (P(), P(AXES), P()))
+        return fn(params, grads["w"], grads["b"])
+
+    flat_p, flat_m, flat_s = run(amp_mod.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis=AXES))
+    hier_p, hier_m, hier_s = run(amp_mod.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis="data", dcn_axis="dcn",
+        dcn_wire=None))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(flat_p[k]),
+                                      np.asarray(hier_p[k]))
+        np.testing.assert_array_equal(np.asarray(flat_m[k]),
+                                      np.asarray(hier_m[k]))
+    np.testing.assert_array_equal(np.asarray(flat_s), np.asarray(hier_s))
+
+
+def _offload_fixtures():
+    n = N_DCN * N_ICI
+    params = {"b": _int_valued(jax.random.PRNGKey(20), (13,)) / 8.0,
+              "v": _int_valued(jax.random.PRNGKey(21), (11, 3)) / 4.0,
+              "w": _int_valued(jax.random.PRNGKey(22), (7, 5)) / 4.0}
+    g1 = {k: _int_valued(jax.random.PRNGKey(30 + i), (n,) + v.shape)
+          for i, (k, v) in enumerate(params.items())}
+    g2 = {k: _int_valued(jax.random.PRNGKey(40 + i), (n,) + v.shape)
+          for i, (k, v) in enumerate(params.items())}
+    return params, g1, g2
+
+
+def _offload_two_step_pair(mesh, mk, params, g1, g2):
+    """(resident, offloaded) two-step drives of the SAME optimizer
+    config: resident runs whole-tree in one shard_map; the offload driver
+    streams host buckets. Returns ((params, masters, loss_scale), ...)
+    with masters keyed by param name on both sides."""
+    from apex_tpu.optimizers.offload import HostOffloadedZero
+
+    mp_r = mk()
+
+    def body(p, ga, gb):
+        st = mp_r.init(p)
+        s = st.scaler.loss_scale
+        p1, st1, _ = mp_r.apply_gradients(
+            st, p, jax.tree.map(lambda g: g[0] * s, ga))
+        s1 = st1.scaler.loss_scale
+        p2, st2, m = mp_r.apply_gradients(
+            st1, p1, jax.tree.map(lambda g: g[0] * s1, gb))
+        return p2, st2.master, m["loss_scale"]
+
+    gspec = {k: P(AXES) for k in params}
+    res_p, res_m, res_s = _smap(
+        mesh, body, (P(), gspec, gspec),
+        ({k: P() for k in params}, {k: P(AXES) for k in params}, P()))(
+            params, g1, g2)
+
+    off = HostOffloadedZero(mk(), mesh, None, num_buckets=2)
+    state = off.init(params)
+    assert len(state.host) == 2  # masters/moments/residual are off-device
+    s = float(state.scaler.loss_scale)
+    p1, state, _ = off.apply_gradients(
+        state, params, jax.tree.map(lambda g: g * s, g1))
+    s = float(state.scaler.loss_scale)
+    p2, state, m = off.apply_gradients(
+        state, p1, jax.tree.map(lambda g: g * s, g2))
+    keys = sorted(params)
+    off_m = {}
+    for b, idxs in enumerate(off._buckets):
+        for i in idxs:
+            off_m[keys[i]] = state.host[b]["master"][str(i)]
+    return (res_p, res_m, res_s), (p2, off_m, m["loss_scale"])
+
+
+def test_offloaded_step_bitmatches_resident(mesh):
+    """HostOffloadedZero: two bucketed host-offloaded steps — masters and
+    momentum round-tripping through host RAM with H2D prefetch — produce
+    bit-identical params, masters, and loss scale vs the resident in-HBM
+    optimizer. Dyadic hyperparameters (lr/momentum powers of two) +
+    integer grads keep every intermediate exactly representable, so the
+    equality survives cross-program FMA contraction (the resident and
+    bucketed programs are DIFFERENT XLA programs)."""
+    from apex_tpu import amp as amp_mod
+    from apex_tpu.optimizers import FusedSGD
+
+    params, g1, g2 = _offload_fixtures()
+    policy = amp_mod.get_policy("O2")
+
+    def mk():
+        return amp_mod.MixedPrecisionOptimizer(
+            FusedSGD(lr=0.03125, momentum=0.5), policy,
+            zero_axis="data", dcn_axis="dcn", dcn_wire=None)
+
+    (res_p, res_m, res_s), (off_p, off_m, off_s) = _offload_two_step_pair(
+        mesh, mk, params, g1, g2)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(res_p[k]),
+                                      np.asarray(off_p[k]))
+        np.testing.assert_array_equal(np.asarray(res_m[k]),
+                                      np.asarray(off_m[k]))
+    np.testing.assert_array_equal(np.asarray(res_s), np.asarray(off_s))
+
+
+def test_offloaded_adam_int8_wire_tracks_resident(mesh):
+    """The full production config — Adam moments + the default int8 DCN
+    wire with its EF residual offloaded per bucket — tracks the resident
+    step to float rounding (Adam's non-dyadic betas admit 1-ulp
+    cross-program FMA differences; anything beyond rounding would mean
+    the residual or moments were mis-bucketed). Also pins the prefetch
+    span evidence: bucket b+1's H2D dispatches before bucket b's apply
+    lands."""
+    from apex_tpu import amp as amp_mod
+    from apex_tpu.monitor import tracing
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.offload import HostOffloadedZero
+
+    params, g1, g2 = _offload_fixtures()
+    policy = amp_mod.get_policy("O2")
+
+    def mk():
+        # dcn_wire defaults to int8: the residual is live, offloaded state
+        return amp_mod.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-2), policy, zero_axis="data", dcn_axis="dcn")
+
+    (res_p, _, res_s), (off_p, _, off_s) = _offload_two_step_pair(
+        mesh, mk, params, g1, g2)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(res_p[k]),
+                                   np.asarray(off_p[k]),
+                                   rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res_s), np.asarray(off_s))
+
+    # timeline evidence: bucket b+1's H2D span is dispatched before
+    # bucket b's apply span lands (the prefetch issue-ahead discipline)
+    off = HostOffloadedZero(mk(), mesh, None, num_buckets=2)
+    state = off.init(params)
+    s = float(state.scaler.loss_scale)
+    with tracing.scoped(tracing.Tracer(None)) as tr:
+        off.apply_gradients(state, params,
+                            jax.tree.map(lambda g: g * s, g1))
+    spans = [r for r in tr.records if r.get("kind") == "span"]
+    h2d = [r for r in spans if r["name"] == "offload.h2d"]
+    app = [r for r in spans if r["name"] == "offload.apply"]
+    assert [r["bucket"] for r in h2d] == [0, 1]
+    assert [r["bucket"] for r in app] == [0, 1]
+    assert h2d[1]["ts"] <= app[0]["ts"] + app[0]["dur_s"]
+
+
+def test_zero_step_dcn_wire_default_and_residual_layout(mesh):
+    """The quantized DCN hop defaults ON (EQuARX): dcn_wire='int8' is the
+    constructor default, the residual covers n_dcn chunks per leaf (1/n_ici
+    the flat quantized residual), and the stepped params TRACK the exact
+    path within the per-block quantization error."""
+    from apex_tpu import amp as amp_mod
+    from apex_tpu.optimizers import FusedAdam
+
+    policy = amp_mod.get_policy("O2")
+    mp_q = amp_mod.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis="data", dcn_axis="dcn")
+    assert mp_q.dcn_wire == "int8"
+    # reduce_dtype is the FLAT quantized wire; the tiers are disjoint
+    with pytest.raises(ValueError, match="reduce_dtype does not compose"):
+        amp_mod.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-2), policy, zero_axis="data", dcn_axis="dcn",
+            reduce_dtype="int8")
+    with pytest.raises(ValueError, match="dcn_axis only applies"):
+        amp_mod.MixedPrecisionOptimizer(
+            FusedAdam(lr=1e-2), policy, dcn_axis="dcn")
+
+    params = {"w": _int_valued(jax.random.PRNGKey(11), (6, 8)) / 4.0}
+    n = N_DCN * N_ICI
+    grads = _int_valued(jax.random.PRNGKey(12), (n, 6, 8))
+
+    def step(mp_opt):
+        def body(p, g):
+            st = mp_opt.init(p)
+            gs = {"w": g[0] * st.scaler.loss_scale}
+            new_p, new_st, _ = mp_opt.apply_gradients(st, p, gs)
+            err = (new_st.residual["err"]["w"]
+                   if new_st.residual is not None else jnp.zeros((0,)))
+            return new_p["w"], err
+
+        return _smap(mesh, body, (P(), P(AXES)), (P(), P(AXES)))(
+            params, grads)
+
+    q_p, q_err = step(mp_q)
+    e_p, _ = step(amp_mod.MixedPrecisionOptimizer(
+        FusedAdam(lr=1e-2), policy, zero_axis="data", dcn_axis="dcn",
+        dcn_wire=None))
+    # residual layout: n_dcn * chunk elements per rank (48/8 = 6 -> 12;
+    # the sharded out-spec concatenates the 8 ranks' leaves)
+    chunk = params["w"].size // n
+    assert q_err.shape == (n * N_DCN * chunk,)
+    err = np.max(np.abs(np.asarray(q_p) - np.asarray(e_p)))
+    assert err < 1e-2  # int8 hop tracks the exact step, does not match it
+    x = jax.random.normal(jax.random.PRNGKey(6),
+                          (N_DCN * N_ICI, 64)) * 3.0
+    shard = P(AXES)
+    out_e = _smap(mesh, lambda x: hierarchy.hier_psum(x, "dcn", "data"),
+                  (shard,), shard)(x)
+    out_q = _smap(mesh, lambda x: hierarchy.hier_psum(
+        x, "dcn", "data", wire_dtype="int8"), (shard,), shard)(x)
+    # quantization is lossy by design: the int8 wire must TRACK the exact
+    # sum (per-block scale bounds the error), not bit-match it
+    err = np.max(np.abs(np.asarray(out_q) - np.asarray(out_e)))
+    scale = np.max(np.abs(np.asarray(out_e))) + 1e-9
+    assert err / scale < 0.05
